@@ -1,0 +1,7 @@
+.PHONY: proto test lint
+
+proto:
+	protoc --python_out=seldon_tpu/proto -I seldon_tpu/proto seldon_tpu/proto/prediction.proto
+
+test:
+	python -m pytest tests/ -x -q

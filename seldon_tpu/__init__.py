@@ -1,0 +1,9 @@
+"""seldon_tpu — a TPU-native inference-graph serving framework.
+
+Capability parity with Seldon Core (reference at /root/reference, see
+SURVEY.md), rebuilt TPU-first: JAX/pjit-sharded model servers over a device
+mesh, a dynamic-batching async orchestrator, dtype-preserving wire codecs,
+and a k8s operator that places inference graphs on TPU node pools.
+"""
+
+__version__ = "0.1.0"

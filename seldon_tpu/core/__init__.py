@@ -1,0 +1,4 @@
+from seldon_tpu.core import payloads
+from seldon_tpu.core.metrics import create_counter, create_gauge, create_timer, validate_metrics
+
+__all__ = ["payloads", "create_counter", "create_gauge", "create_timer", "validate_metrics"]

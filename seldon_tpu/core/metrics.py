@@ -1,0 +1,55 @@
+"""Custom-metrics helpers returned from user `metrics()` hooks.
+
+Parity: /root/reference/python/seldon_core/metrics.py:1-89. Metric dicts are
+propagated through `Meta.metrics` and aggregated by the orchestrator into
+Prometheus counters/gauges/histograms.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+COUNTER = "COUNTER"
+GAUGE = "GAUGE"
+TIMER = "TIMER"
+
+_TYPES = (COUNTER, GAUGE, TIMER)
+
+
+def create_counter(key: str, value: float, tags: Optional[Dict[str, str]] = None) -> dict:
+    return _metric(key, COUNTER, value, tags)
+
+
+def create_gauge(key: str, value: float, tags: Optional[Dict[str, str]] = None) -> dict:
+    return _metric(key, GAUGE, value, tags)
+
+
+def create_timer(key: str, value: float, tags: Optional[Dict[str, str]] = None) -> dict:
+    """value is milliseconds, matching the reference's TIMER convention."""
+    return _metric(key, TIMER, value, tags)
+
+
+def _metric(key: str, mtype: str, value: float, tags: Optional[Dict[str, str]]) -> dict:
+    m = {"key": key, "type": mtype, "value": float(value)}
+    if tags:
+        m["tags"] = {str(k): str(v) for k, v in tags.items()}
+    return m
+
+
+def validate_metrics(metrics: List[dict]) -> bool:
+    """Schema check mirroring reference `validate_metrics`
+    (/root/reference/python/seldon_core/metrics.py:41-57)."""
+    if not isinstance(metrics, (list, tuple)):
+        return False
+    for m in metrics:
+        if not isinstance(m, dict):
+            return False
+        if "key" not in m or "value" not in m:
+            return False
+        if m.get("type", COUNTER) not in _TYPES:
+            return False
+        try:
+            float(m["value"])
+        except (TypeError, ValueError):
+            return False
+    return True

@@ -1,0 +1,11 @@
+"""Wire protocol for seldon_tpu.
+
+`prediction_pb2` is generated from `prediction.proto` by `protoc --python_out`
+(regenerate with `make proto` at the repo root). The gRPC service layer is
+hand-written in `prediction_grpc.py` because the runtime image ships grpcio but
+not grpcio-tools; it is also clearer than generated stubs.
+"""
+
+from seldon_tpu.proto import prediction_pb2
+
+__all__ = ["prediction_pb2"]

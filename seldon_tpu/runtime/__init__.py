@@ -1,0 +1,3 @@
+from seldon_tpu.runtime.user_model import SeldonComponent, SeldonNotImplementedError
+
+__all__ = ["SeldonComponent", "SeldonNotImplementedError"]

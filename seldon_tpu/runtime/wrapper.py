@@ -1,0 +1,316 @@
+"""Per-unit serving surface: REST (aiohttp) + gRPC servers.
+
+Parity: reference wrapper (/root/reference/python/seldon_core/wrapper.py:18-143)
+— Flask routes /predict, /transform-input, /transform-output, /route,
+/aggregate, /send-feedback and gRPC servicers for every unit type.
+
+TPU-native redesign:
+ * asyncio (aiohttp) instead of blocking Flask workers: user hooks run on a
+   bounded thread pool, so one slow predict doesn't stall health probes, and
+   one process saturates a chip without gunicorn forking (forked workers
+   would each need their own TPU program + HBM copy of the weights).
+ * REST accepts/returns either JSON (`application/json`, reference-compatible)
+   or binary proto (`application/x-protobuf`) — the dense-tensor fast path
+   works over plain HTTP too, not just gRPC.
+ * /live, /ready, /metrics (Prometheus), /metadata built in.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import logging
+import time
+from typing import Any, Optional
+
+import grpc
+from aiohttp import web
+
+from seldon_tpu.core import payloads
+from seldon_tpu.proto import prediction_pb2 as pb
+from seldon_tpu.proto import prediction_grpc
+from seldon_tpu.runtime import seldon_methods
+from seldon_tpu.runtime.metrics_server import ServerMetrics, get_default_metrics
+from seldon_tpu.runtime.user_model import SeldonNotImplementedError
+
+logger = logging.getLogger(__name__)
+
+PROTO_CONTENT_TYPE = "application/x-protobuf"
+
+
+def _unit_name() -> str:
+    import os
+
+    return os.environ.get("PREDICTIVE_UNIT_ID", "model")
+
+_METHOD_TABLE = {
+    "predict": (seldon_methods.predict, pb.SeldonMessage),
+    "transform-input": (seldon_methods.transform_input, pb.SeldonMessage),
+    "transform-output": (seldon_methods.transform_output, pb.SeldonMessage),
+    "route": (seldon_methods.route, pb.SeldonMessage),
+    "aggregate": (seldon_methods.aggregate, pb.SeldonMessageList),
+    "send-feedback": (seldon_methods.send_feedback, pb.Feedback),
+}
+
+
+class SeldonMicroserviceException(Exception):
+    """Error envelope matching reference flask_utils.py:38-60."""
+
+    def __init__(self, message: str, status_code: int = 400, reason: str = "MICROSERVICE_BAD_DATA"):
+        super().__init__(message)
+        self.message = message
+        self.status_code = status_code
+        self.reason = reason
+
+    def to_dict(self) -> dict:
+        return {
+            "status": {
+                "status": 1,
+                "info": self.message,
+                "code": -1,
+                "reason": self.reason,
+            }
+        }
+
+
+# ---------------------------------------------------------------------------
+# REST
+# ---------------------------------------------------------------------------
+
+
+def build_rest_app(
+    user_obj: Any,
+    executor: Optional[concurrent.futures.Executor] = None,
+    metrics: Optional[ServerMetrics] = None,
+) -> web.Application:
+    executor = executor or concurrent.futures.ThreadPoolExecutor(max_workers=8)
+    metrics = metrics or get_default_metrics()
+    app = web.Application(client_max_size=1024**3)
+    app["user_obj"] = user_obj
+    app["executor"] = executor
+    app["metrics"] = metrics
+
+    async def _parse_request(request: web.Request, req_cls):
+        ctype = request.headers.get("Content-Type", "")
+        if ctype.startswith(PROTO_CONTENT_TYPE):
+            body = await request.read()
+            return req_cls.FromString(body), "proto"
+        if request.method == "GET":
+            raw = request.query.get("json")
+            if raw is None:
+                raise SeldonMicroserviceException("empty json parameter in request")
+            return payloads.dict_to_message(json.loads(raw), req_cls), "json"
+        if ctype.startswith("application/json"):
+            payload = await request.json()
+        else:
+            form = await request.post()
+            raw = form.get("json")
+            if raw is None:
+                raise SeldonMicroserviceException("no json payload in request")
+            payload = json.loads(raw)
+        return payloads.dict_to_message(payload, req_cls), "json"
+
+    def _handler(method_name: str):
+        fn, req_cls = _METHOD_TABLE[method_name]
+
+        async def handle(request: web.Request) -> web.Response:
+            t0 = time.perf_counter()
+            try:
+                msg, encoding = await _parse_request(request, req_cls)
+            except SeldonMicroserviceException as e:
+                return web.json_response(e.to_dict(), status=e.status_code)
+            except Exception as e:
+                err = SeldonMicroserviceException(f"bad request: {e}")
+                return web.json_response(err.to_dict(), status=400)
+            loop = asyncio.get_running_loop()
+            try:
+                resp = await loop.run_in_executor(
+                    request.app["executor"], fn, request.app["user_obj"], msg
+                )
+            except SeldonMicroserviceException as e:
+                return web.json_response(e.to_dict(), status=e.status_code)
+            except Exception as e:
+                logger.exception("user code failed in %s", method_name)
+                err = SeldonMicroserviceException(str(e), 500, "MICROSERVICE_INTERNAL_ERROR")
+                return web.json_response(err.to_dict(), status=500)
+            dt = time.perf_counter() - t0
+            request.app["metrics"].observe(method_name, "rest", dt, resp)
+            if method_name == "send-feedback":
+                request.app["metrics"].record_reward(_unit_name(), msg.reward)
+            if encoding == "proto":
+                return web.Response(
+                    body=resp.SerializeToString(), content_type=PROTO_CONTENT_TYPE
+                )
+            return web.json_response(payloads.message_to_dict(resp))
+
+        return handle
+
+    for name in _METHOD_TABLE:
+        app.router.add_post(f"/{name}", _handler(name))
+        app.router.add_get(f"/{name}", _handler(name))
+        # Versioned aliases matching reference external API shape.
+        app.router.add_post(f"/api/v0.1/{name}", _handler(name))
+        app.router.add_post(f"/api/v1.0/{name}", _handler(name))
+
+    async def handle_generate(request: web.Request) -> web.Response:
+        try:
+            msg, encoding = await _parse_request(request, pb.GenerateRequest)
+        except Exception as e:
+            return web.json_response(SeldonMicroserviceException(str(e)).to_dict(), status=400)
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        try:
+            resp = await loop.run_in_executor(
+                request.app["executor"], seldon_methods.generate, request.app["user_obj"], msg
+            )
+        except Exception as e:
+            logger.exception("generate failed")
+            return web.json_response(
+                SeldonMicroserviceException(str(e), 500).to_dict(), status=500
+            )
+        request.app["metrics"].observe("generate", "rest", time.perf_counter() - t0, None)
+        if encoding == "proto":
+            return web.Response(body=resp.SerializeToString(), content_type=PROTO_CONTENT_TYPE)
+        return web.json_response(payloads.message_to_dict(resp))
+
+    app.router.add_post("/generate", handle_generate)
+    app.router.add_post("/api/v1.0/generate", handle_generate)
+
+    async def handle_live(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_ready(request: web.Request) -> web.Response:
+        hs = getattr(user_obj, "health_status", None)
+        if callable(hs):
+            try:
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(request.app["executor"], hs)
+            except SeldonNotImplementedError:
+                pass
+            except Exception as e:
+                return web.json_response({"status": "unavailable", "error": str(e)}, status=503)
+        return web.json_response({"status": "ready"})
+
+    async def handle_metadata(request: web.Request) -> web.Response:
+        im = getattr(user_obj, "init_metadata", None)
+        if callable(im):
+            try:
+                return web.json_response(im() or {})
+            except Exception:
+                pass
+        return web.json_response({})
+
+    async def handle_metrics(request: web.Request) -> web.Response:
+        body, ctype = metrics.export()
+        return web.Response(body=body, content_type=ctype.split(";")[0])
+
+    app.router.add_get("/live", handle_live)
+    app.router.add_get("/health/live", handle_live)
+    app.router.add_get("/ready", handle_ready)
+    app.router.add_get("/health/ready", handle_ready)
+    app.router.add_get("/ping", handle_live)
+    app.router.add_get("/metadata", handle_metadata)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/prometheus", handle_metrics)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# gRPC
+# ---------------------------------------------------------------------------
+
+
+class _UnitServicer:
+    """One servicer speaking every unit-type service; only registered methods
+    the user object can actually serve (prediction_grpc skips missing)."""
+
+    def __init__(self, user_obj: Any, metrics: Optional[ServerMetrics] = None):
+        self._user = user_obj
+        self._metrics = metrics or get_default_metrics()
+
+    def _run(self, name: str, fn, request, context):
+        t0 = time.perf_counter()
+        try:
+            resp = fn(self._user, request)
+        except Exception as e:  # pragma: no cover - error path
+            logger.exception("grpc %s failed", name)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return None
+        self._metrics.observe(name, "grpc", time.perf_counter() - t0, resp)
+        return resp
+
+    def Predict(self, request, context):
+        return self._run("predict", seldon_methods.predict, request, context)
+
+    def TransformInput(self, request, context):
+        return self._run("transform-input", seldon_methods.transform_input, request, context)
+
+    def TransformOutput(self, request, context):
+        return self._run("transform-output", seldon_methods.transform_output, request, context)
+
+    def Route(self, request, context):
+        return self._run("route", seldon_methods.route, request, context)
+
+    def Aggregate(self, request, context):
+        return self._run("aggregate", seldon_methods.aggregate, request, context)
+
+    def SendFeedback(self, request, context):
+        resp = self._run("send-feedback", seldon_methods.send_feedback, request, context)
+        if resp is not None:
+            self._metrics.record_reward(_unit_name(), request.reward)
+        return resp
+
+    def Generate(self, request, context):
+        return self._run("generate", seldon_methods.generate, request, context)
+
+    def GenerateStream(self, request, context):
+        """Server-streaming generation: uses the user's `generate_stream`
+        iterator hook if present, else degrades to a single-chunk stream
+        around `generate`."""
+        t0 = time.perf_counter()
+        try:
+            it = seldon_methods.generate_stream(self._user, request)
+            try:
+                first = next(it)
+            except StopIteration:
+                first = None
+            except SeldonNotImplementedError:
+                # No streaming hook: single-chunk stream around generate().
+                first, it = seldon_methods.generate(self._user, request), iter(())
+            if first is not None:
+                yield first
+                yield from it
+        except Exception as e:  # pragma: no cover - error path
+            logger.exception("grpc generate-stream failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return
+        self._metrics.observe("generate-stream", "grpc", time.perf_counter() - t0, None)
+
+
+def build_grpc_server(
+    user_obj: Any,
+    max_workers: int = 8,
+    max_message_bytes: int = 512 * 1024 * 1024,
+    metrics: Optional[ServerMetrics] = None,
+) -> grpc.Server:
+    options = [
+        ("grpc.max_send_message_length", max_message_bytes),
+        ("grpc.max_receive_message_length", max_message_bytes),
+    ]
+    server = grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers), options=options
+    )
+    servicer = _UnitServicer(user_obj, metrics)
+    for service in (
+        "Generic",
+        "Model",
+        "Router",
+        "Transformer",
+        "OutputTransformer",
+        "Combiner",
+        "Seldon",
+        "TextGen",
+    ):
+        prediction_grpc.add_servicer(server, service, servicer)
+    return server

@@ -1,0 +1,21 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh so sharding
+tests run without TPU hardware (mirrors how the driver dry-runs multichip)."""
+
+import os
+import sys
+
+# Hard-set (not setdefault): the runtime image presets JAX_PLATFORMS=axon,
+# which would make every test wait on the single real TPU chip's tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The image's sitecustomize imports jax and calls jax.config.update(
+# "jax_platforms", "axon,cpu") at interpreter start, which overrides the env
+# var above. Re-point the config at cpu before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

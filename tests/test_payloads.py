@@ -1,0 +1,137 @@
+"""Codec tests, mirroring reference python/tests/test_utils.py coverage."""
+
+import numpy as np
+import pytest
+
+from seldon_tpu.core import payloads
+from seldon_tpu.proto import prediction_pb2 as pb
+
+
+class TestDenseTensor:
+    @pytest.mark.parametrize(
+        "dtype",
+        [np.float32, np.float64, np.int32, np.int64, np.uint8, np.float16, np.bool_],
+    )
+    def test_roundtrip_dtypes(self, dtype):
+        arr = np.arange(12).reshape(3, 4).astype(dtype)
+        out = payloads.dense_to_array(payloads.array_to_dense(arr))
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out, arr)
+
+    def test_bfloat16_roundtrip(self):
+        import ml_dtypes
+
+        arr = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 4)
+        dense = payloads.array_to_dense(arr)
+        assert dense.dtype == pb.DT_BFLOAT16
+        out = payloads.dense_to_array(dense)
+        assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(out.astype(np.float32), arr.astype(np.float32))
+
+    def test_jax_array_input(self):
+        import jax.numpy as jnp
+
+        arr = jnp.ones((2, 3), dtype=jnp.bfloat16)
+        out = payloads.dense_to_array(payloads.array_to_dense(arr))
+        assert out.shape == (2, 3)
+
+    def test_wire_roundtrip(self):
+        arr = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+        msg = payloads.build_message(arr, kind="dense")
+        wire = msg.SerializeToString()
+        back = pb.SeldonMessage.FromString(wire)
+        np.testing.assert_array_equal(payloads.get_data_from_message(back), arr)
+
+
+class TestReferenceForms:
+    def test_tensor_roundtrip(self):
+        arr = np.array([[1.0, 2.0], [3.0, 4.0]])
+        out = payloads.tensor_to_array(payloads.array_to_tensor(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_ndarray_roundtrip(self):
+        arr = np.array([[1.0, 2.0], [3.0, 4.0]])
+        out = payloads.listvalue_to_array(payloads.array_to_listvalue(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_bin_and_str_data(self):
+        msg = payloads.build_message(b"\x00\x01binary")
+        assert payloads.get_data_from_message(msg) == b"\x00\x01binary"
+        msg = payloads.build_message("hello")
+        assert payloads.get_data_from_message(msg) == "hello"
+
+    def test_json_data(self):
+        msg = payloads.build_message({"a": [1, 2], "b": "x"}, kind="jsonData")
+        assert payloads.get_data_from_message(msg) == {"a": [1.0, 2.0], "b": "x"}
+
+    def test_names(self):
+        data = payloads.array_to_data(np.zeros((1, 2)), names=["f0", "f1"], kind="tensor")
+        assert list(data.names) == ["f0", "f1"]
+
+
+class TestConstructResponse:
+    def test_mirrors_request_kind(self):
+        for kind in ("dense", "tensor", "ndarray"):
+            req = payloads.build_message(np.ones((2, 2)), kind=kind)
+            resp = payloads.construct_response(None, False, req, np.zeros((2, 2)))
+            assert payloads.data_kind(resp) == kind
+
+    def test_propagates_puid(self):
+        req = payloads.build_message(np.ones((1, 1)))
+        req.meta.puid = "xyz"
+        resp = payloads.construct_response(None, False, req, np.zeros((1, 1)))
+        assert resp.meta.puid == "xyz"
+
+    def test_tags_and_metrics(self):
+        req = payloads.build_message(np.ones((1, 1)))
+        resp = payloads.construct_response(
+            None,
+            False,
+            req,
+            np.zeros((1, 1)),
+            tags={"version": "v2", "n": 3},
+            metrics=[{"key": "k", "type": "GAUGE", "value": 1.5}],
+        )
+        assert resp.meta.tags["version"].string_value == "v2"
+        assert resp.meta.tags["n"].number_value == 3
+        assert resp.meta.metrics[0].key == "k"
+        assert resp.meta.metrics[0].type == pb.Metric.GAUGE
+        assert resp.meta.metrics[0].value == pytest.approx(1.5)
+
+    def test_class_names_used(self):
+        class M:
+            def class_names(self):
+                return ["c0", "c1"]
+
+        req = payloads.build_message(np.ones((1, 2)), kind="tensor")
+        resp = payloads.construct_response(M(), False, req, np.zeros((1, 2)))
+        assert list(resp.data.names) == ["c0", "c1"]
+
+    def test_passthrough_proto(self):
+        req = payloads.build_message(np.ones((1, 1)))
+        inner = payloads.build_message(np.zeros((1, 1)))
+        resp = payloads.construct_response(None, False, req, inner)
+        assert resp is inner
+
+
+class TestJsonCodec:
+    def test_dict_roundtrip(self):
+        msg = payloads.build_message(np.ones((2, 2)), kind="tensor")
+        msg.meta.puid = "p1"
+        d = payloads.message_to_dict(msg)
+        back = payloads.dict_to_message(d)
+        assert back.meta.puid == "p1"
+        np.testing.assert_array_equal(payloads.get_data_from_message(back), np.ones((2, 2)))
+
+    def test_rest_style_ndarray_payload(self):
+        d = {"data": {"names": ["a", "b"], "ndarray": [[1, 2], [3, 4]]}}
+        msg = payloads.dict_to_message(d)
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(msg), np.array([[1, 2], [3, 4]])
+        )
+
+    def test_feedback_json(self):
+        fb = payloads.json_to_feedback(
+            {"request": {"data": {"ndarray": [[1]]}}, "reward": 0.5}
+        )
+        assert fb.reward == pytest.approx(0.5)

@@ -1,0 +1,134 @@
+"""Regression tests for the first code-review pass findings."""
+
+import numpy as np
+import pytest
+
+from seldon_tpu.core import payloads
+from seldon_tpu.proto import prediction_pb2 as pb
+from seldon_tpu.runtime import seldon_methods
+from seldon_tpu.runtime.metrics_server import ServerMetrics
+
+
+def _metric(key, mtype, value, tags=None):
+    m = pb.Metric(key=key, type=mtype, value=value)
+    for k, v in (tags or {}).items():
+        m.tags[k] = v
+    return m
+
+
+class TestCustomMetricCollisions:
+    def test_same_key_different_tags_does_not_raise(self):
+        sm = ServerMetrics()
+        sm.record_custom([_metric("mymetric", pb.Metric.COUNTER, 1.0)])
+        # Previously raised 'Duplicated timeseries'; now dropped with a log.
+        sm.record_custom([_metric("mymetric", pb.Metric.COUNTER, 1.0, {"a": "b"})])
+        sm.record_custom([_metric("mymetric", pb.Metric.GAUGE, 2.0)])
+        body, _ = sm.export()
+        assert b"mymetric_total 1.0" in body
+
+    def test_observe_never_raises(self):
+        sm = ServerMetrics()
+        msg = pb.SeldonMessage()
+        msg.meta.metrics.add().key = "seldon_api_executor_server_requests"  # collides
+        sm.observe("predict", "rest", 0.01, msg)  # must not raise
+
+    def test_reward_counters(self):
+        sm = ServerMetrics()
+        sm.record_reward("router", 0.5)
+        sm.record_reward("router", -0.25)
+        body, _ = sm.export()
+        assert b'seldon_api_model_feedback_total{unit="router"} 2.0' in body
+        assert b'seldon_api_model_feedback_reward_total{unit="router"} 0.5' in body
+        assert b'reward_negative_total{unit="router"} 0.25' in body
+
+
+class TestRawHookErrors:
+    def test_attribute_error_in_raw_hook_surfaces(self):
+        calls = []
+
+        class Buggy:
+            def predict_raw(self, msg):
+                return self.no_such_attr  # genuine bug, must surface
+
+            def predict(self, X, names, meta=None):
+                calls.append(1)
+                return X
+
+        req = payloads.build_message(np.ones((1, 1)))
+        with pytest.raises(AttributeError):
+            seldon_methods.predict(Buggy(), req)
+        assert calls == []  # high-level path must NOT run as a fallback
+
+
+class TestNonNumericOutputs:
+    def test_string_labels_fall_back_to_ndarray(self):
+        class Labeler:
+            def predict(self, X, names, meta=None):
+                return np.array(["cat", "dog"])
+
+        req = payloads.build_message(np.ones((2, 4)), kind="dense")
+        resp = seldon_methods.predict(Labeler(), req)
+        assert payloads.data_kind(resp) == "ndarray"
+        assert list(payloads.get_data_from_message(resp)) == ["cat", "dog"]
+
+    def test_dict_output_becomes_jsondata(self):
+        class Dicty:
+            def predict(self, X, names, meta=None):
+                return {"label": "cat", "score": 0.9}
+
+        req = payloads.build_message(np.ones((1, 1)), kind="dense")
+        resp = seldon_methods.predict(Dicty(), req)
+        out = payloads.get_data_from_message(resp)
+        assert out == {"label": "cat", "score": 0.9}
+
+
+class TestInPlaceMutation:
+    def test_dense_payload_is_writable(self):
+        class Mutator:
+            def predict(self, X, names, meta=None):
+                X += 1  # in-place, sklearn-scaler style
+                return X
+
+        req = payloads.build_message(np.zeros((2, 2), dtype=np.float32), kind="dense")
+        resp = seldon_methods.predict(Mutator(), req)
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.ones((2, 2))
+        )
+
+    def test_zero_copy_path_available(self):
+        dense = payloads.array_to_dense(np.arange(4.0))
+        ro = payloads.dense_to_array(dense, writable=False)
+        assert not ro.flags.writeable
+
+
+class TestGenerateStream:
+    def test_stream_hook(self):
+        class Streamer:
+            def generate_stream(self, req):
+                for i in range(3):
+                    yield {"text": f"t{i}", "token_ids": [i]}
+
+        req = pb.GenerateRequest(prompt="x")
+        chunks = list(seldon_methods.generate_stream(Streamer(), req))
+        assert [c.text for c in chunks] == ["t0", "t1", "t2"]
+
+    def test_grpc_stream_falls_back_to_unary(self):
+        import grpc as grpc_mod
+
+        from seldon_tpu.proto import prediction_grpc
+        from seldon_tpu.runtime.wrapper import build_grpc_server
+
+        class UnaryOnly:
+            def generate(self, req):
+                return {"text": "single", "token_ids": [7]}
+
+        server = build_grpc_server(UnaryOnly())
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            ch = grpc_mod.insecure_channel(f"127.0.0.1:{port}")
+            stub = prediction_grpc.TextGenStub(ch)
+            chunks = list(stub.GenerateStream(pb.GenerateRequest(prompt="x")))
+            assert len(chunks) == 1 and chunks[0].text == "single"
+        finally:
+            server.stop(0)

@@ -1,0 +1,164 @@
+"""Method-dispatch tests with inline user objects, mirroring the reference's
+python/tests/test_model_microservice.py / test_router_microservice.py /
+test_combiner_microservice.py fixtures."""
+
+import numpy as np
+import pytest
+
+from seldon_tpu.core import payloads
+from seldon_tpu.proto import prediction_pb2 as pb
+from seldon_tpu.runtime import seldon_methods, user_model
+
+
+class UserModel(user_model.SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return X * 2
+
+    def tags(self):
+        return {"model": "double"}
+
+    def metrics(self):
+        return [{"key": "calls", "type": "COUNTER", "value": 1}]
+
+
+class RawModel:
+    def predict_raw(self, msg):
+        X = payloads.get_data_from_message(msg)
+        return payloads.build_message(X + 1)
+
+
+class Transformer:
+    def transform_input(self, X, names, meta=None):
+        return X - 1
+
+
+class OutTransformer:
+    def transform_output(self, X, names, meta=None):
+        return X * 10
+
+
+class Router:
+    def route(self, X, names):
+        return 1
+
+
+class BadRouter:
+    def route(self, X, names):
+        return "not an int"
+
+
+class Combiner:
+    def aggregate(self, Xs, names_list):
+        return np.mean(np.stack(Xs), axis=0)
+
+
+class FeedbackRouter:
+    def __init__(self):
+        self.seen = []
+
+    def route(self, X, names):
+        return 0
+
+    def send_feedback(self, X, names, reward, truth, routing=None):
+        self.seen.append((reward, routing))
+
+
+def _req(arr=None, kind="dense"):
+    return payloads.build_message(np.ones((2, 3)) if arr is None else arr, kind=kind)
+
+
+class TestPredict:
+    def test_basic(self):
+        resp = seldon_methods.predict(UserModel(), _req())
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.full((2, 3), 2.0)
+        )
+
+    def test_tags_and_metrics_attached(self):
+        resp = seldon_methods.predict(UserModel(), _req())
+        assert resp.meta.tags["model"].string_value == "double"
+        assert resp.meta.metrics[0].key == "calls"
+
+    def test_raw_hook_wins(self):
+        resp = seldon_methods.predict(RawModel(), _req())
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.full((2, 3), 2.0)
+        )
+
+    def test_kind_mirrored(self):
+        resp = seldon_methods.predict(UserModel(), _req(kind="ndarray"))
+        assert payloads.data_kind(resp) == "ndarray"
+
+
+class TestTransforms:
+    def test_input(self):
+        resp = seldon_methods.transform_input(Transformer(), _req())
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.zeros((2, 3))
+        )
+
+    def test_output(self):
+        resp = seldon_methods.transform_output(OutTransformer(), _req())
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.full((2, 3), 10.0)
+        )
+
+    def test_identity_fallthrough(self):
+        req = _req()
+        resp = seldon_methods.transform_input(object(), req)
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.ones((2, 3))
+        )
+
+
+class TestRoute:
+    def test_branch_payload(self):
+        resp = seldon_methods.route(Router(), _req())
+        out = payloads.get_data_from_message(resp)
+        assert out.shape == (1, 1)
+        assert int(out[0, 0]) == 1
+
+    def test_bad_return_type(self):
+        with pytest.raises(TypeError):
+            seldon_methods.route(BadRouter(), _req())
+
+
+class TestAggregate:
+    def test_mean(self):
+        msgs = pb.SeldonMessageList(
+            seldonMessages=[_req(np.zeros((2, 2))), _req(np.full((2, 2), 2.0))]
+        )
+        resp = seldon_methods.aggregate(Combiner(), msgs)
+        np.testing.assert_array_equal(
+            payloads.get_data_from_message(resp), np.ones((2, 2))
+        )
+
+
+class TestSendFeedback:
+    def test_routing_passed(self):
+        r = FeedbackRouter()
+        fb = pb.Feedback()
+        fb.request.CopyFrom(_req())
+        fb.request.meta.routing["router"] = 1
+        fb.reward = 0.75
+        seldon_methods.send_feedback(r, fb, unit_name="router")
+        assert r.seen == [(0.75, 1)]
+
+    def test_no_hook_is_noop(self):
+        fb = pb.Feedback()
+        fb.request.CopyFrom(_req())
+        resp = seldon_methods.send_feedback(object(), fb)
+        assert isinstance(resp, pb.SeldonMessage)
+
+
+class TestGenerate:
+    def test_dispatch(self):
+        class Gen:
+            def generate(self, req):
+                return {"text": "hi", "token_ids": [1, 2], "ttft_ms": 3.0}
+
+        req = pb.GenerateRequest(prompt="hello", max_new_tokens=2)
+        resp = seldon_methods.generate(Gen(), req)
+        assert resp.text == "hi"
+        assert list(resp.token_ids) == [1, 2]
+        assert resp.completion_tokens == 2
