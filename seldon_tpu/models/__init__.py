@@ -1,0 +1,28 @@
+"""TPU-native model zoo for seldon_tpu.
+
+The reference serves models as black-box CPU microservices (sklearn joblib,
+xgboost boosters, tfserving sidecars — /root/reference/servers/). Here the
+flagship leaf is a JAX transformer family (Llama-style dense + MoE) designed
+for the MXU: bf16 matmuls, scanned layers, static shapes, pjit/GSPMD
+sharding over a device mesh.
+"""
+
+from seldon_tpu.models.config import ModelConfig, PRESETS, get_config
+from seldon_tpu.models.transformer import (
+    init_params,
+    forward,
+    prefill,
+    decode_step,
+    init_cache,
+)
+
+__all__ = [
+    "ModelConfig",
+    "PRESETS",
+    "get_config",
+    "init_params",
+    "forward",
+    "prefill",
+    "decode_step",
+    "init_cache",
+]

@@ -1,0 +1,107 @@
+"""Model configuration for the transformer family.
+
+Configs are static dataclasses so every shape is known at trace time —
+XLA requirement (no dynamic shapes under jit). Presets cover the bench
+ladder: `tiny` (CPU tests), `bench-1b` (fits one v5e chip in bf16),
+`llama3-8b` (the BASELINE.json north-star target, TP over a v5e-8 slice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense). Expert-parallel ('ep') only engages when >0.
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    eos_token_id: int = 128001
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+    def validate(self) -> "ModelConfig":
+        assert self.d_model % self.n_heads == 0, "d_model must divide by n_heads"
+        assert self.n_heads % self.n_kv_heads == 0, "n_heads must divide by n_kv_heads"
+        if self.n_experts:
+            assert self.n_experts_per_token <= self.n_experts
+        return self
+
+
+PRESETS = {
+    # CPU-testable config: every dim divides an 8-way mesh.
+    "tiny": ModelConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        eos_token_id=1,
+    ),
+    "tiny-moe": ModelConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        eos_token_id=1,
+        n_experts=4,
+        n_experts_per_token=2,
+    ),
+    # ~1.1B params: single v5e chip (16 GB HBM) with room for KV cache.
+    "bench-1b": ModelConfig(
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=5632,
+        max_seq_len=2048,
+        rope_theta=10000.0,
+        eos_token_id=2,
+    ),
+    # The north-star serving target (BASELINE.json): Llama-3-8B geometry.
+    "llama3-8b": ModelConfig(),
+    "llama3-70b": ModelConfig(
+        d_model=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=28672,
+    ),
+}
+
+
+def get_config(name_or_cfg, **overrides) -> ModelConfig:
+    if isinstance(name_or_cfg, ModelConfig):
+        cfg = name_or_cfg
+    else:
+        cfg = PRESETS[name_or_cfg]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg.validate()

@@ -1,0 +1,65 @@
+"""Batched autoregressive generation: prefill + lax.scan decode.
+
+The whole generate path is a single jitted function per (batch, prompt-len,
+max-new-tokens) bucket: prefill fills the KV cache, a `lax.scan` of
+`decode_step` produces tokens with per-row sampling knobs, EOS rows freeze
+via value-level masking (no dynamic shapes, no host round-trip per token).
+Serving-side bucketing keeps the number of compiled variants small
+(servers/jaxserver.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from seldon_tpu.models import transformer
+from seldon_tpu.models.config import ModelConfig
+from seldon_tpu.models.sampling import sample
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens")
+)
+def generate(
+    params,
+    tokens: jnp.ndarray,  # [B, S] right-padded prompts
+    prompt_lens: jnp.ndarray,  # [B]
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    cfg: ModelConfig,
+    max_new_tokens: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (out_tokens [B, max_new_tokens], out_lens [B]).
+
+    Rows stop at cfg.eos_token_id; positions past EOS hold pad_token_id.
+    """
+    B, S = tokens.shape
+    cache = transformer.init_cache(cfg, B, S + max_new_tokens)
+    logits, cache = transformer.prefill(params, tokens, prompt_lens, cache, cfg)
+
+    def step(carry, step_key):
+        logits, cache, pos, done = carry
+        tok = sample(logits, step_key, temperature, top_k, top_p)
+        tok = jnp.where(done, cfg.pad_token_id, tok)
+        new_done = done | (tok == cfg.eos_token_id)
+        logits, cache = transformer.decode_step(params, tok, pos, cache, cfg)
+        return (logits, cache, pos + 1, new_done), tok
+
+    done0 = jnp.zeros((B,), dtype=bool)
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _, _, done), toks = jax.lax.scan(
+        step, (logits, cache, prompt_lens, done0), keys
+    )
+    out = jnp.swapaxes(toks, 0, 1)  # [B, T]
+    # Length = tokens up to and including EOS (or T if never finished).
+    is_eos = out == cfg.eos_token_id
+    first_eos = jnp.argmax(is_eos, axis=-1)
+    has_eos = jnp.any(is_eos, axis=-1)
+    out_lens = jnp.where(has_eos, first_eos + 1, max_new_tokens)
+    return out, out_lens

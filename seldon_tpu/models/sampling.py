@@ -1,0 +1,60 @@
+"""Token sampling — temperature / top-k / top-p, fully jittable.
+
+All branching is value-level (jnp.where), never Python-level, so one
+compiled sampler serves every request config; per-request knobs arrive as
+arrays and sampling stays inside the jitted decode loop (no host sync per
+token — the reference has no generation path at all, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Host-side request knobs; converted to per-row arrays by the server."""
+
+    temperature: float = 0.7
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # 1.0 = disabled
+    max_new_tokens: int = 128
+    seed: int = 0
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] f32; 0 => greedy
+    top_k: jnp.ndarray,  # [B] int32; 0 => off
+    top_p: jnp.ndarray,  # [B] f32; 1.0 => off
+) -> jnp.ndarray:
+    """Returns sampled token ids [B]."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest logit per row.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # whose cumulative probability covers p; always keep the argmax (so
+    # top_p<=0 degrades to greedy rather than an all-masked row).
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    inside = cum - probs_sorted < jnp.maximum(top_p, 1e-9)[:, None]
+    cut = jnp.where(inside, sorted_logits, jnp.inf)
+    min_keep = jnp.min(cut, axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < min_keep, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)

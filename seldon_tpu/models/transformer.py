@@ -1,0 +1,341 @@
+"""Llama-family transformer, functional JAX, TPU-first.
+
+Design (vs the reference's black-box CPU model servers, SURVEY.md §2.5):
+ * Params are a plain pytree with layers STACKED on a leading [L, ...] axis
+   and the forward pass is a `lax.scan` over layers — one traced block, so
+   compile time is O(1) in depth and XLA fuses each block aggressively.
+ * bf16 params/compute, f32 for norms/softmax/logits (MXU-friendly).
+ * Static shapes everywhere; decode is a fixed-size KV cache with per-row
+   write positions, so the whole generate loop jits once per bucket.
+ * GQA + RoPE (half-split convention, HF-compatible) + SwiGLU; optional
+   MoE blocks (top-k routing, experts sharded over 'ep').
+ * Sharding is supplied externally (parallel/sharding.py) via GSPMD specs;
+   this file only places `with_sharding_constraint` hints on activations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from seldon_tpu.models.config import ModelConfig
+
+Params = Dict[str, Any]
+Cache = Dict[str, jnp.ndarray]
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    cfg = cfg.validate()
+    dt = _dtype(cfg)
+    L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    k = iter(jax.random.split(key, 16))
+
+    def norm(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def dense(key, *shape, scale=0.02):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dt)
+
+    out_scale = 0.02 / (2 * L) ** 0.5  # residual-stream init damping
+    blocks = {
+        "attn_norm": norm(L, D),
+        "wq": dense(next(k), L, D, H * Dh),
+        "wk": dense(next(k), L, D, Hkv * Dh),
+        "wv": dense(next(k), L, D, Hkv * Dh),
+        "wo": dense(next(k), L, H * Dh, D, scale=out_scale),
+        "mlp_norm": norm(L, D),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        blocks.update(
+            {
+                "router": dense(next(k), L, D, E).astype(jnp.float32),
+                "w_gate": dense(next(k), L, E, D, F),
+                "w_up": dense(next(k), L, E, D, F),
+                "w_down": dense(next(k), L, E, F, D, scale=out_scale),
+            }
+        )
+    else:
+        blocks.update(
+            {
+                "w_gate": dense(next(k), L, D, F),
+                "w_up": dense(next(k), L, D, F),
+                "w_down": dense(next(k), L, F, D, scale=out_scale),
+            }
+        )
+    params: Params = {
+        "embed": dense(next(k), V, D),
+        "blocks": blocks,
+        "final_norm": norm(D),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), D, V)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Primitive layers
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * w).astype(x.dtype)
+
+
+def rope_frequencies(cfg: ModelConfig) -> jnp.ndarray:
+    half = cfg.head_dim // 2
+    return 1.0 / (cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray):
+    """x: [B, S, H, Dh], positions: [B, S] -> rotated x (half-split pairing)."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def gqa_attention(
+    q: jnp.ndarray,  # [B, Sq, H, Dh]
+    k: jnp.ndarray,  # [B, Skv, Hkv, Dh]
+    v: jnp.ndarray,  # [B, Skv, Hkv, Dh]
+    mask: jnp.ndarray,  # [B, Sq, Skv] bool (True = attend)
+) -> jnp.ndarray:
+    """Grouped-query attention, f32 softmax. Returns [B, Sq, H*Dh]."""
+    B, Sq, H, Dh = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    q = q.reshape(B, Sq, Hkv, G, Dh)
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", q, k, preferred_element_type=jnp.float32
+    ) / (Dh**0.5)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", w, v)
+    return out.reshape(B, Sq, H * Dh)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    return jnp.einsum(
+        "bsf,fd->bsd", jax.nn.silu(jnp.einsum("bsd,df->bsf", x, w_gate))
+        * jnp.einsum("bsd,df->bsf", x, w_up), w_down
+    )
+
+
+def moe_block(x: jnp.ndarray, bp: Dict[str, jnp.ndarray], cfg: ModelConfig):
+    """Top-k MoE. Dense-mixing formulation: every expert runs on every token
+    and results are combined with the (sparsified) router weights. This is
+    compute-inflated by E/k but fully static-shaped and shards cleanly over
+    'ep'; the dropless all_to_all dispatch path is ops/moe_dispatch.py's job
+    once capacity-based routing lands.
+    """
+    B, S, D = x.shape
+    E, K = cfg.n_experts, cfg.n_experts_per_token
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), bp["router"])
+    top_vals, top_idx = jax.lax.top_k(logits, K)  # [B,S,K]
+    gates = jax.nn.softmax(top_vals, axis=-1)
+    # Scatter the top-k gates back into a dense [B,S,E] mixing matrix.
+    onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B,S,K,E]
+    mix = jnp.einsum("bske,bsk->bse", onehot, gates)
+    hidden = jax.nn.silu(jnp.einsum("bsd,edf->besf", x, bp["w_gate"])) * jnp.einsum(
+        "bsd,edf->besf", x, bp["w_up"]
+    )
+    expert_out = jnp.einsum("besf,efd->besd", hidden, bp["w_down"])
+    return jnp.einsum("besd,bse->bsd", expert_out, mix.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Transformer block via lax.scan
+# ---------------------------------------------------------------------------
+
+
+def _block(
+    x: jnp.ndarray,
+    bp: Dict[str, jnp.ndarray],
+    cfg: ModelConfig,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+    mask: jnp.ndarray,
+    kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    write_pos: Optional[jnp.ndarray] = None,
+    act_spec: Optional[P] = None,
+):
+    """One transformer block. If `kv` is given (decode/prefill with cache),
+    keys/values are written into it at `write_pos` and attention runs over
+    the cache; returns (x_out, (k_cache, v_cache))."""
+    B, S, D = x.shape
+    Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, bp["attn_norm"], cfg.rms_norm_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, bp["wq"]).reshape(B, S, cfg.n_heads, Dh)
+    k = jnp.einsum("bsd,dh->bsh", h, bp["wk"]).reshape(B, S, Hkv, Dh)
+    v = jnp.einsum("bsd,dh->bsh", h, bp["wv"]).reshape(B, S, Hkv, Dh)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    if kv is not None:
+        ck, cv = kv
+        if S == ck.shape[1]:
+            # Prefill covering the whole cache window: plain slot write.
+            ck, cv = k, v
+        else:
+            rows = jnp.arange(B)
+            idx = write_pos[:, None] + jnp.arange(S)[None, :]  # [B,S]
+            ck = ck.at[rows[:, None], idx].set(k.astype(ck.dtype))
+            cv = cv.at[rows[:, None], idx].set(v.astype(cv.dtype))
+        attn = gqa_attention(q, ck.astype(q.dtype), cv.astype(q.dtype), mask)
+        new_kv = (ck, cv)
+    else:
+        attn = gqa_attention(q, k, v, mask)
+        new_kv = None
+
+    x = x + jnp.einsum("bsh,hd->bsd", attn, bp["wo"])
+    if act_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+    h = rms_norm(x, bp["mlp_norm"], cfg.rms_norm_eps)
+    if cfg.n_experts:
+        x = x + moe_block(h, bp, cfg)
+    else:
+        x = x + swiglu(h, bp["w_gate"], bp["w_up"], bp["w_down"])
+    if act_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+    return x, new_kv
+
+
+def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
+                write_pos=None, act_spec=None, remat=False):
+    """lax.scan over the stacked layer axis."""
+
+    if cache is None:
+
+        def body(carry, bp):
+            out, _ = _block(carry, bp, cfg, positions, inv_freq, mask,
+                            act_spec=act_spec)
+            return out, None
+
+        if remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+        return x, None
+
+    def body(carry, scanned):
+        bp, ck, cv = scanned
+        out, (nk, nv) = _block(carry, bp, cfg, positions, inv_freq, mask,
+                               kv=(ck, cv), write_pos=write_pos,
+                               act_spec=act_spec)
+        return out, (nk, nv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    return x, {"k": new_k, "v": new_v}
+
+
+def _logits(params, x, cfg):
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    cfg: ModelConfig,
+    act_spec: Optional[P] = None,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Full-sequence teacher-forced logits [B, S, V] (training / scoring)."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if act_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    inv_freq = rope_frequencies(cfg)
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
+    x, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                       act_spec=act_spec, remat=remat)
+    return _logits(params, x, cfg)
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> Cache:
+    dt = dtype or _dtype(cfg)
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
+
+
+def prefill(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] right-padded prompts
+    prompt_lens: jnp.ndarray,  # [B] true lengths
+    cache: Cache,
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, Cache]:
+    """Run prompts through the model, filling cache slots [0, S).
+    Returns (next-token logits [B, V] taken at each row's last real token,
+    updated cache)."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    inv_freq = rope_frequencies(cfg)
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
+    Smax = cache["k"].shape[2]
+    write_pos = jnp.zeros((B,), dtype=jnp.int32)
+    if S == Smax:
+        x, cache = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                               cache=cache, write_pos=write_pos)
+    else:
+        # Write k/v into the leading S slots of the cache.
+        sub = {"k": cache["k"][:, :, :S], "v": cache["v"][:, :, :S]}
+        x, sub = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                             cache=sub, write_pos=write_pos)
+        cache = {
+            "k": cache["k"].at[:, :, :S].set(sub["k"]),
+            "v": cache["v"].at[:, :, :S].set(sub["v"]),
+        }
+    logits = _logits(params, x, cfg)  # [B, S, V]
+    last = jnp.clip(prompt_lens - 1, 0, S - 1)
+    return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
+
+
+def decode_step(
+    params: Params,
+    token: jnp.ndarray,  # [B] int32 current tokens
+    pos: jnp.ndarray,  # [B] int32 positions to write at
+    cache: Cache,
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, Cache]:
+    """One autoregressive step. Returns (logits [B, V], updated cache)."""
+    B = token.shape[0]
+    Smax = cache["k"].shape[2]
+    x = jnp.take(params["embed"], token, axis=0)[:, None, :]  # [B,1,D]
+    positions = pos[:, None]
+    inv_freq = rope_frequencies(cfg)
+    # Attend to every cache slot <= own position (slot pos is written first).
+    mask = (jnp.arange(Smax)[None, None, :] <= pos[:, None, None])  # [B,1,Smax]
+    x, cache = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                           cache=cache, write_pos=pos)
+    return _logits(params, x, cfg)[:, 0], cache

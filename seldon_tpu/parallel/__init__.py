@@ -1,0 +1,31 @@
+"""Parallelism layer: device meshes, sharding rules, collectives.
+
+The reference's only distributed axes are k8s replicas + scatter/gather over
+graph branches (SURVEY.md §2.9 — no NCCL/MPI/TP/PP/SP anywhere). The TPU
+build makes intra-model parallelism first-class: a `jax.sharding.Mesh` with
+axes (dp, pp, sp, tp, ep), GSPMD PartitionSpec rules for every param/
+activation, and shard_map collectives (ring attention over 'sp') that ride
+ICI instead of DCN.
+"""
+
+from seldon_tpu.parallel.mesh import MeshPlan, make_mesh, local_mesh
+from seldon_tpu.parallel.sharding import (
+    param_pspecs,
+    cache_pspec,
+    batch_pspec,
+    activation_pspec,
+    shard_tree,
+    named_shardings,
+)
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "local_mesh",
+    "param_pspecs",
+    "cache_pspec",
+    "batch_pspec",
+    "activation_pspec",
+    "shard_tree",
+    "named_shardings",
+]

@@ -1,0 +1,79 @@
+"""Device-mesh construction.
+
+Axis order is chosen for ICI locality: the most communication-intensive
+axis ('tp' — per-layer all-reduces) is innermost so it maps to adjacent
+chips on the torus; 'dp' (gradient all-reduce once per step, or fully
+independent in serving) is outermost and may span DCN on multi-slice.
+
+Axes:
+  dp — data parallel (batch sharding; serving: independent request lanes)
+  pp — pipeline parallel (layer-stage sharding; 1 unless enabled)
+  sp — sequence/context parallel (ring attention over long sequences)
+  tp — tensor parallel (Megatron-style head/ffn sharding)
+  ep — expert parallel (MoE expert sharding; 1 for dense models)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "sp", "ep", "tp")  # tp innermost → adjacent ICI chips
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A named factorization of the device count over the parallel axes."""
+
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> dict:
+        return {a: getattr(self, a) for a in AXES}
+
+    @staticmethod
+    def auto(n_devices: int, cfg=None) -> "MeshPlan":
+        """Pick a sane default factorization for `n_devices`.
+
+        Serving default: TP as wide as the model's KV heads allow (TP must
+        divide n_kv_heads so KV cache shards evenly), DP for the rest.
+        """
+        if n_devices == 1:
+            return MeshPlan()
+        tp_cap = n_devices
+        if cfg is not None:
+            tp_cap = math.gcd(n_devices, cfg.n_kv_heads)
+        tp = 1
+        # Largest power-of-two tp ≤ tp_cap that divides n_devices.
+        while tp * 2 <= tp_cap and n_devices % (tp * 2) == 0:
+            tp *= 2
+        return MeshPlan(dp=n_devices // tp, tp=tp)
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < plan.n_devices:
+        raise ValueError(
+            f"mesh plan needs {plan.n_devices} devices, have {len(devices)}"
+        )
+    devices = devices[: plan.n_devices]
+    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.sp, plan.ep, plan.tp)
+    return Mesh(arr, AXES)
+
+
+def local_mesh(cfg=None) -> Mesh:
+    """Mesh over all visible devices with an auto plan."""
+    n = len(jax.devices())
+    return make_mesh(MeshPlan.auto(n, cfg))

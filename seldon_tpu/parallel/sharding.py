@@ -1,0 +1,81 @@
+"""GSPMD sharding rules for the transformer param/activation trees.
+
+Megatron-style tensor parallelism expressed as PartitionSpecs: attention
+heads and FFN hidden dim shard over 'tp' (column-parallel in, row-parallel
+out → one psum per block, inserted by XLA); vocab shards over 'tp' for
+embed/lm_head; batch over 'dp'; sequence over 'sp' (training/long-context);
+MoE experts over 'ep'. Pipeline ('pp') is handled by shard_map microbatching
+in parallel/pipeline.py, not by a weight spec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_pspecs(cfg) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models.transformer.init_params."""
+    blocks = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.n_experts:
+        blocks.update(
+            {
+                "router": P(None, None, None),
+                "w_gate": P(None, "ep", None, "tp"),
+                "w_up": P(None, "ep", None, "tp"),
+                "w_down": P(None, "ep", "tp", None),
+            }
+        )
+    else:
+        blocks.update(
+            {
+                "w_gate": P(None, None, "tp"),
+                "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),
+            }
+        )
+    specs = {
+        "embed": P("tp", None),
+        "blocks": blocks,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def cache_pspec() -> P:
+    """KV cache [L, B, S, Hkv, Dh]: batch over dp, kv heads over tp."""
+    return P(None, "dp", None, "tp", None)
+
+
+def batch_pspec(seq_sharded: bool = False) -> P:
+    """Token batch [B, S]."""
+    return P("dp", "sp" if seq_sharded else None)
+
+
+def activation_pspec(seq_sharded: bool = False) -> P:
+    """Hidden activations [B, S, D]."""
+    return P("dp", "sp" if seq_sharded else None, None)
+
+
+def named_shardings(mesh: Mesh, pspec_tree: Any) -> Any:
+    return jax.tree.map(
+        lambda p: NamedSharding(mesh, p),
+        pspec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_tree(tree: Any, pspec_tree: Any, mesh: Mesh) -> Any:
+    """Commit a pytree to the mesh under the given specs."""
+    return jax.device_put(tree, named_shardings(mesh, pspec_tree))
