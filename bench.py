@@ -1,0 +1,82 @@
+"""Benchmark entry: prints ONE JSON line for the driver.
+
+Measures end-to-end batched generation (prefill 128 + decode 128) on the
+`bench-1b` flagship config on whatever accelerator is visible (the driver
+runs this on one real TPU chip). Metric is requests/s/chip; vs_baseline is
+against the BASELINE.json north star of 1000 req/s on a v5e-8 slice,
+i.e. 125 req/s/chip.
+
+Reference baselines (SURVEY.md §6) measure the Java engine with a stub
+model (12k req/s REST / 28k gRPC on n1-standard-16) — orchestrator-only,
+no model compute; those get a separate orchestrator bench once the graph
+engine lands. This one measures what the reference never could: real
+transformer serving throughput per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BATCH = 8
+PROMPT_LEN = 128
+NEW_TOKENS = 128
+BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_tpu.models import get_config, init_params
+    from seldon_tpu.models.generate import generate
+
+    cfg = get_config("bench-1b")
+    params = init_params(cfg, jax.random.key(0))
+
+    tokens = jax.random.randint(
+        jax.random.key(1), (BATCH, PROMPT_LEN), 3, cfg.vocab_size
+    )
+    lens = jnp.full((BATCH,), PROMPT_LEN, jnp.int32)
+    temp = jnp.full((BATCH,), 0.7)
+    top_k = jnp.full((BATCH,), 40, jnp.int32)
+    top_p = jnp.full((BATCH,), 0.95)
+
+    import numpy as np
+
+    def run(key):
+        out, out_lens = generate(
+            params, tokens, lens, key, temp, top_k, top_p, cfg, NEW_TOKENS
+        )
+        # Materialize on host: under the axon tunnel block_until_ready can
+        # return before execution finishes, inflating throughput ~1000x.
+        return np.asarray(out)
+
+    run(jax.random.key(2))  # compile
+    n_iters = 3
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        run(jax.random.key(3 + i))
+    dt = time.perf_counter() - t0
+
+    total_reqs = BATCH * n_iters
+    req_s = total_reqs / dt
+    tok_s = total_reqs * NEW_TOKENS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "generate_req_per_s_per_chip",
+                "value": round(req_s, 3),
+                "unit": "req/s (batch8, prefill128+decode128, bench-1b bf16)",
+                "vs_baseline": round(req_s / BASELINE_REQ_S_PER_CHIP, 3),
+                "detail": {
+                    "decode_tokens_per_s": round(tok_s, 1),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

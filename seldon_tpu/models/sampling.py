@@ -48,7 +48,11 @@ def sample(
     # top-p (nucleus): keep the smallest prefix of the sorted distribution
     # whose cumulative probability covers p; always keep the argmax (so
     # top_p<=0 degrades to greedy rather than an all-masked row).
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    # The post-top-k sorted view is the first sort with ranks >= k masked —
+    # no second O(V log V) sort in the per-token hot loop.
+    sorted_logits = jnp.where(
+        jnp.arange(V)[None, :] >= k[:, None], -jnp.inf, sorted_desc
+    )
     probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs_sorted, axis=-1)
     inside = cum - probs_sorted < jnp.maximum(top_p, 1e-9)[:, None]

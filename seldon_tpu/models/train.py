@@ -33,8 +33,6 @@ class TrainState(NamedTuple):
 def _decay_mask(params):
     """Decay matrices only — norm gains are [L, D] in the layer-stacked
     layout, so an ndim test would wrongly decay them; go by name."""
-    from jax.tree_util import tree_flatten_with_path, tree_unflatten
-
     leaves, treedef = tree_flatten_with_path(params)
     out = [
         leaf.ndim >= 2 and not any("norm" in str(k) for k in path)
@@ -55,16 +53,23 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
     )
 
 
+MOE_AUX_WEIGHT = 0.01
+
+
 def loss_fn(params, tokens, loss_mask, cfg: ModelConfig, act_spec=None):
-    """Next-token CE. tokens [B,S]; loss_mask [B,S] (0 on pad/prompt)."""
-    logits = transformer.forward(params, tokens, cfg, act_spec=act_spec,
-                                 remat=True)
+    """Next-token CE (+ router load-balance aux for MoE configs).
+    tokens [B,S]; loss_mask [B,S] (0 on pad/prompt)."""
+    logits, aux = transformer.forward(params, tokens, cfg, act_spec=act_spec,
+                                      remat=True, return_aux=True)
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
     mask = loss_mask[:, 1:].astype(jnp.float32)
     denom = jnp.maximum(mask.sum(), 1.0)
-    return (nll * mask).sum() / denom
+    ce = (nll * mask).sum() / denom
+    if cfg.n_experts:
+        ce = ce + MOE_AUX_WEIGHT * aux["moe_lb_loss"]
+    return ce
 
 
 def _shardings_like(shape_tree, params_ns_tree, repl: NamedSharding):

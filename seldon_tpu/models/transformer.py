@@ -149,16 +149,21 @@ def moe_block(x: jnp.ndarray, bp: Dict[str, jnp.ndarray], cfg: ModelConfig):
     B, S, D = x.shape
     E, K = cfg.n_experts, cfg.n_experts_per_token
     logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), bp["router"])
+    probs_full = jax.nn.softmax(logits, axis=-1)  # [B,S,E] f32
     top_vals, top_idx = jax.lax.top_k(logits, K)  # [B,S,K]
     gates = jax.nn.softmax(top_vals, axis=-1)
     # Scatter the top-k gates back into a dense [B,S,E] mixing matrix.
     onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B,S,K,E]
     mix = jnp.einsum("bske,bsk->bse", onehot, gates)
+    # Switch-style load-balance aux: E * Σ_e frac_routed(e) · mean_prob(e);
+    # minimized (→1) by a uniform router, grows as experts collapse.
+    frac = onehot.sum(axis=2).mean(axis=(0, 1)) / K  # [E]
+    lb_loss = E * jnp.sum(frac * probs_full.mean(axis=(0, 1)))
     hidden = jax.nn.silu(jnp.einsum("bsd,edf->besf", x, bp["w_gate"])) * jnp.einsum(
         "bsd,edf->besf", x, bp["w_up"]
     )
     expert_out = jnp.einsum("besf,efd->besd", hidden, bp["w_down"])
-    return jnp.einsum("besd,bse->bsd", expert_out, mix.astype(x.dtype))
+    return jnp.einsum("besd,bse->bsd", expert_out, mix.astype(x.dtype)), lb_loss
 
 
 # ---------------------------------------------------------------------------
@@ -210,13 +215,15 @@ def _block(
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
     h = rms_norm(x, bp["mlp_norm"], cfg.rms_norm_eps)
+    aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
-        x = x + moe_block(h, bp, cfg)
+        mlp_out, aux = moe_block(h, bp, cfg)
+        x = x + mlp_out
     else:
         x = x + swiglu(h, bp["w_gate"], bp["w_up"], bp["w_down"])
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
-    return x, new_kv
+    return x, new_kv, aux
 
 
 def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
@@ -226,26 +233,26 @@ def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
     if cache is None:
 
         def body(carry, bp):
-            out, _ = _block(carry, bp, cfg, positions, inv_freq, mask,
-                            act_spec=act_spec)
-            return out, None
+            out, _, aux = _block(carry, bp, cfg, positions, inv_freq, mask,
+                                 act_spec=act_spec)
+            return out, aux
 
         if remat:
             body = jax.checkpoint(body)
-        x, _ = jax.lax.scan(body, x, params["blocks"])
-        return x, None
+        x, aux = jax.lax.scan(body, x, params["blocks"])
+        return x, None, jnp.mean(aux)
 
     def body(carry, scanned):
         bp, ck, cv = scanned
-        out, (nk, nv) = _block(carry, bp, cfg, positions, inv_freq, mask,
-                               kv=(ck, cv), write_pos=write_pos,
-                               act_spec=act_spec)
-        return out, (nk, nv)
+        out, (nk, nv), aux = _block(carry, bp, cfg, positions, inv_freq, mask,
+                                    kv=(ck, cv), write_pos=write_pos,
+                                    act_spec=act_spec)
+        return out, (nk, nv, aux)
 
-    x, (new_k, new_v) = jax.lax.scan(
+    x, (new_k, new_v, aux) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"])
     )
-    return x, {"k": new_k, "v": new_v}
+    return x, {"k": new_k, "v": new_v}, jnp.mean(aux)
 
 
 def _logits(params, x, cfg):
@@ -267,8 +274,11 @@ def forward(
     cfg: ModelConfig,
     act_spec: Optional[P] = None,
     remat: bool = False,
-) -> jnp.ndarray:
-    """Full-sequence teacher-forced logits [B, S, V] (training / scoring)."""
+    return_aux: bool = False,
+):
+    """Full-sequence teacher-forced logits [B, S, V] (training / scoring).
+    With return_aux=True also returns {"moe_lb_loss": scalar} (zero for
+    dense configs)."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)
     if act_spec is not None:
@@ -276,9 +286,12 @@ def forward(
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     inv_freq = rope_frequencies(cfg)
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
-    x, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                       act_spec=act_spec, remat=remat)
-    return _logits(params, x, cfg)
+    x, _, aux = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                            act_spec=act_spec, remat=remat)
+    logits = _logits(params, x, cfg)
+    if return_aux:
+        return logits, {"moe_lb_loss": aux}
+    return logits
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> Cache:
@@ -305,20 +318,23 @@ def prefill(
     Smax = cache["k"].shape[2]
     write_pos = jnp.zeros((B,), dtype=jnp.int32)
     if S == Smax:
-        x, cache = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                               cache=cache, write_pos=write_pos)
+        x, cache, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                                  cache=cache, write_pos=write_pos)
     else:
         # Write k/v into the leading S slots of the cache.
         sub = {"k": cache["k"][:, :, :S], "v": cache["v"][:, :, :S]}
-        x, sub = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                             cache=sub, write_pos=write_pos)
+        x, sub, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                                cache=sub, write_pos=write_pos)
         cache = {
             "k": cache["k"].at[:, :, :S].set(sub["k"]),
             "v": cache["v"].at[:, :, :S].set(sub["v"]),
         }
-    logits = _logits(params, x, cfg)  # [B, S, V]
+    # Gather each row's last real hidden state BEFORE the vocab projection:
+    # projecting all S positions would materialize [B,S,V] f32 (~4 GB for an
+    # 8k-prompt llama3-8b bucket) only to keep one row.
     last = jnp.clip(prompt_lens - 1, 0, S - 1)
-    return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,D]
+    return _logits(params, x_last, cfg)[:, 0], cache
 
 
 def decode_step(
@@ -336,6 +352,6 @@ def decode_step(
     inv_freq = rope_frequencies(cfg)
     # Attend to every cache slot <= own position (slot pos is written first).
     mask = (jnp.arange(Smax)[None, None, :] <= pos[:, None, None])  # [B,1,Smax]
-    x, cache = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                           cache=cache, write_pos=pos)
+    x, cache, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                              cache=cache, write_pos=pos)
     return _logits(params, x, cfg)[:, 0], cache

@@ -3,7 +3,8 @@
 The reference's only distributed axes are k8s replicas + scatter/gather over
 graph branches (SURVEY.md §2.9 — no NCCL/MPI/TP/PP/SP anywhere). The TPU
 build makes intra-model parallelism first-class: a `jax.sharding.Mesh` with
-axes (dp, pp, sp, tp, ep), GSPMD PartitionSpec rules for every param/
+axes (dp, pp, sp, ep, tp — tp innermost for ICI locality), GSPMD
+PartitionSpec rules for every param/
 activation, and shard_map collectives (ring attention over 'sp') that ride
 ICI instead of DCN.
 """
