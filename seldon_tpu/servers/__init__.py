@@ -1,0 +1,7 @@
+"""Prepackaged model servers (reference: /root/reference/servers/).
+
+The flagship is `jaxserver` — the TPU-native citizen the reference never
+had (its GPU route was a TensorRT proxy, integrations/nvidia-inference-server/
+TRTProxy.py): pjit-sharded transformer inference with slot-based continuous
+batching. sklearn/xgboost/mlflow parity servers live alongside.
+"""

@@ -1,0 +1,63 @@
+"""Checkpoint save/restore (orbax) + model config serialization.
+
+Reference parity: model weights are immutable artifacts downloaded at pod
+start (model_initializer_injector.go:65-228 / storage.py:38). Here the
+artifact is an orbax checkpoint directory:
+
+    <dir>/config.json      — ModelConfig fields
+    <dir>/params/          — orbax PyTree checkpoint (bf16 tensors)
+    <dir>/tokenizer.*      — optional HF tokenizer files
+
+Restore is sharding-aware: given a mesh, params materialize directly into
+their GSPMD layout (each host reads only its shards on multi-host)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import jax
+
+from seldon_tpu.models import transformer
+from seldon_tpu.models.config import ModelConfig, get_config
+from seldon_tpu.parallel import sharding as shd
+
+
+def save_checkpoint(path: str, params, cfg: ModelConfig) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(dataclasses.asdict(cfg), f, indent=1)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "params"), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_config(path: str) -> ModelConfig:
+    with open(os.path.join(path, "config.json")) as f:
+        return get_config(ModelConfig(**json.load(f)))
+
+
+def load_checkpoint(path: str, mesh=None):
+    """-> (params, cfg). With a mesh, params restore pre-sharded."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    cfg = load_config(path)
+    shape_tree = jax.eval_shape(
+        lambda: transformer.init_params(cfg, jax.random.key(0))
+    )
+    if mesh is not None:
+        ns = shd.named_shardings(mesh, shd.param_pspecs(cfg))
+        shape_tree = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shape_tree,
+            ns,
+        )
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(path, "params"), shape_tree)
+    return params, cfg

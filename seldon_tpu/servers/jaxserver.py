@@ -1,0 +1,250 @@
+"""JAXServer — the TPU-native prepackaged model server.
+
+The reference's closest thing is the TensorRT proxy
+(/root/reference/integrations/nvidia-inference-server/TRTProxy.py:31-81) plus
+per-framework CPU servers (/root/reference/servers/*). JAXServer replaces
+that whole route: it loads a transformer checkpoint (orbax dir via
+`model_uri`, or a named preset with synthetic weights), shards it over the
+local device mesh (auto TP×DP plan), and serves:
+
+ * `generate` / `generate_stream` — continuous-batched text generation
+   through the InferenceEngine (TTFT measured server-side),
+ * `predict` — sequence scoring: token ids [B, S] -> per-row mean NLL
+   (teacher-forced), the LM equivalent of a model server's score output,
+ * custom metrics (engine stats) surfaced through the standard
+   `Meta.metrics` channel the reference's engine aggregates.
+
+Works as a `SeldonComponent`, so the microservice CLI, graph orchestrator,
+and contract tester all drive it like any other unit.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from seldon_tpu.models.config import ModelConfig, get_config
+from seldon_tpu.models.sampling import SamplingParams
+from seldon_tpu.runtime.user_model import SeldonComponent
+from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+from seldon_tpu.servers.tokenizer import ByteTokenizer, load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class JAXServer(SeldonComponent):
+    supports_batching = True
+
+    def __init__(
+        self,
+        model_uri: Optional[str] = None,
+        preset: str = "bench-1b",
+        max_slots: int = 8,
+        max_seq_len: int = 0,
+        init_seed: int = 0,
+    ):
+        self.model_uri = model_uri
+        self.preset = preset
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.init_seed = int(init_seed)
+        self._loaded = False
+        self._load_lock = threading.Lock()
+        self.engine: Optional[InferenceEngine] = None
+        self.cfg: Optional[ModelConfig] = None
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def load(self) -> None:
+        with self._load_lock:
+            if self._loaded:
+                return
+            import jax
+
+            from seldon_tpu.models import transformer
+            from seldon_tpu.parallel import MeshPlan, make_mesh
+            from seldon_tpu.parallel import sharding as shd
+
+            if self.model_uri:
+                from seldon_tpu.servers import checkpoint as ckpt
+                from seldon_tpu.servers.storage import download
+
+                local = download(self.model_uri)
+                self.tokenizer = load_tokenizer(local)
+                mesh = self._mesh_for(ckpt.load_config(local))
+                params, cfg = ckpt.load_checkpoint(local, mesh)
+            else:
+                cfg = get_config(self.preset)
+                self.tokenizer = ByteTokenizer()
+                if cfg.vocab_size >= ByteTokenizer.vocab_size:
+                    cfg = get_config(
+                        cfg,
+                        eos_token_id=self.tokenizer.eos_token_id,
+                        pad_token_id=self.tokenizer.pad_token_id,
+                    )
+                mesh = self._mesh_for(cfg)
+                with mesh:
+                    params = jax.jit(
+                        lambda k: transformer.init_params(cfg, k),
+                        out_shardings=shd.named_shardings(
+                            mesh, shd.param_pspecs(cfg)
+                        ),
+                    )(jax.random.key(self.init_seed))
+            self.cfg = cfg
+            self.mesh = mesh
+            seq = self.max_seq_len or cfg.max_seq_len
+            buckets = tuple(
+                b for b in (32, 128, 512, 1024, 2048, 4096) if b <= seq
+            ) or (seq,)
+            self.engine = InferenceEngine(
+                params,
+                cfg,
+                EngineConfig(
+                    max_slots=self.max_slots,
+                    max_seq_len=seq,
+                    prompt_buckets=buckets,
+                ),
+                mesh=mesh,
+            )
+            self.engine.start()
+            self.params = params
+            self._loaded = True
+            logger.info(
+                "JAXServer loaded: cfg=%s mesh=%s slots=%d seq=%d",
+                self.preset if not self.model_uri else self.model_uri,
+                mesh.shape if mesh else None,
+                self.max_slots,
+                seq,
+            )
+
+    def _mesh_for(self, cfg):
+        import jax
+
+        from seldon_tpu.parallel import MeshPlan, make_mesh
+
+        return make_mesh(MeshPlan.auto(len(jax.devices()), cfg))
+
+    def _ensure_loaded(self):
+        if not self._loaded:
+            self.load()
+
+    def health_status(self):
+        self._ensure_loaded()
+        return {"engine": self.engine.stats.snapshot()}
+
+    def init_metadata(self) -> Dict:
+        self._ensure_loaded()
+        import dataclasses
+
+        return {
+            "name": "jaxserver",
+            "config": dataclasses.asdict(self.cfg),
+            "mesh": {k: int(v) for k, v in self.mesh.shape.items()},
+        }
+
+    # --- text generation ----------------------------------------------------
+
+    def _to_sampling(self, request: Dict) -> SamplingParams:
+        return SamplingParams(
+            temperature=float(request.get("temperature") or 0.7),
+            top_k=int(request.get("top_k") or 0),
+            top_p=float(request.get("top_p") or 1.0),
+            max_new_tokens=int(request.get("max_new_tokens") or 16),
+            seed=int(request.get("seed") or 0),
+        )
+
+    def _prompt_ids(self, request: Dict) -> List[int]:
+        ids = list(request.get("prompt_token_ids") or [])
+        if not ids and request.get("prompt"):
+            ids = self.tokenizer.encode(request["prompt"])
+        if not ids:
+            raise ValueError("generate request has no prompt")
+        return ids
+
+    def generate(self, request: Dict) -> Dict:
+        self._ensure_loaded()
+        t0 = time.perf_counter()
+        ids = self._prompt_ids(request)
+        result = self.engine.generate_blocking(ids, self._to_sampling(request))
+        toks = result["token_ids"]
+        if toks and toks[-1] == self.cfg.eos_token_id:
+            toks = toks[:-1]
+        return {
+            "text": self.tokenizer.decode(toks),
+            "token_ids": toks,
+            "ttft_ms": result["ttft_ms"] or 0.0,
+            "total_ms": 1000.0 * (time.perf_counter() - t0),
+            "prompt_tokens": len(ids),
+            "completion_tokens": len(toks),
+        }
+
+    def generate_stream(self, request: Dict):
+        self._ensure_loaded()
+        t0 = time.perf_counter()
+        ids = self._prompt_ids(request)
+        out_q = self.engine.submit(ids, self._to_sampling(request))
+        n = 0
+        while True:
+            item = out_q.get()
+            if item is None:
+                break
+            tok = item["token"]
+            if tok == self.cfg.eos_token_id:
+                continue
+            n += 1
+            yield {
+                "text": self.tokenizer.decode([tok]),
+                "token_ids": [tok],
+                "ttft_ms": item.get("ttft_ms", 0.0),
+                "total_ms": 1000.0 * (time.perf_counter() - t0),
+                "prompt_tokens": len(ids),
+                "completion_tokens": n,
+            }
+
+    # --- scoring (MODEL predict parity) -------------------------------------
+
+    def predict(
+        self, X: np.ndarray, names: Iterable[str], meta: Optional[Dict] = None
+    ) -> np.ndarray:
+        """Token ids [B, S] -> per-row mean next-token NLL [B] (lower =
+        model finds the sequence more likely)."""
+        self._ensure_loaded()
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_tpu.models import transformer
+
+        toks = jnp.asarray(np.asarray(X, dtype=np.int32))
+        if toks.ndim == 1:
+            toks = toks[None]
+
+        @jax.jit
+        def score(params, toks):
+            logits = transformer.forward(params, toks, self.cfg)
+            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+            nll = -jnp.take_along_axis(lp, toks[:, 1:, None], axis=-1)[..., 0]
+            return nll.mean(axis=-1)
+
+        return np.asarray(score(self.params, toks))
+
+    # --- observability ------------------------------------------------------
+
+    def metrics(self) -> List[Dict]:
+        if not self._loaded:
+            return []
+        s = self.engine.stats.snapshot()
+        return [
+            {"type": "GAUGE", "key": "jaxserver_mean_ttft_ms",
+             "value": s["mean_ttft_ms"]},
+            {"type": "GAUGE", "key": "jaxserver_tokens_out",
+             "value": float(s["tokens_out"])},
+            {"type": "GAUGE", "key": "jaxserver_completed",
+             "value": float(s["completed"])},
+        ]
+
+    def tags(self) -> Dict:
+        return {"server": "jaxserver", "preset": self.preset}

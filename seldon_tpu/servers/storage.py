@@ -1,0 +1,84 @@
+"""Model artifact download (reference: python/seldon_core/storage.py:38-164
+and the kfserving model-initializer initContainer,
+operator/controllers/model_initializer_injector.go:65-228).
+
+Supported URIs: local paths and file:// always; gs:// via google.cloud.storage
+and s3:// via boto3/minio only if those clients exist in the image (they are
+not baked in — gated, with a clear error instead of an import crash)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+
+_DOWNLOAD_DIR = os.environ.get("SELDON_TPU_MODEL_DIR", "/mnt/models")
+
+
+def download(uri: str, out_dir: str | None = None) -> str:
+    """Fetch `uri` into a local directory; returns the local path.
+    Local paths pass through untouched."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if uri.startswith("gs://"):
+        return _download_gcs(uri, out_dir)
+    if uri.startswith("s3://"):
+        return _download_s3(uri, out_dir)
+    if os.path.exists(uri):
+        return uri
+    raise ValueError(f"unsupported or missing model uri: {uri!r}")
+
+
+def _target_dir(out_dir: str | None) -> str:
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        return out_dir
+    base = _DOWNLOAD_DIR if os.access(os.path.dirname(_DOWNLOAD_DIR) or "/", os.W_OK) else None
+    try:
+        if base:
+            os.makedirs(base, exist_ok=True)
+            return base
+    except OSError:
+        pass
+    return tempfile.mkdtemp(prefix="seldon-tpu-model-")
+
+
+def _download_gcs(uri: str, out_dir: str | None) -> str:
+    try:
+        from google.cloud import storage as gcs
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "gs:// model uris need google-cloud-storage, not present in "
+            "this image; mount the model or use file://"
+        ) from e
+    bucket_name, _, prefix = uri[len("gs://"):].partition("/")
+    target = _target_dir(out_dir)
+    client = gcs.Client()
+    for blob in client.bucket(bucket_name).list_blobs(prefix=prefix):
+        rel = os.path.relpath(blob.name, prefix) if prefix else blob.name
+        dst = os.path.join(target, rel)
+        os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
+        blob.download_to_filename(dst)
+    return target
+
+
+def _download_s3(uri: str, out_dir: str | None) -> str:
+    try:
+        import boto3
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "s3:// model uris need boto3, not present in this image; "
+            "mount the model or use file://"
+        ) from e
+    bucket_name, _, prefix = uri[len("s3://"):].partition("/")
+    target = _target_dir(out_dir)
+    s3 = boto3.client(
+        "s3", endpoint_url=os.environ.get("AWS_ENDPOINT_URL") or None
+    )
+    resp = s3.list_objects_v2(Bucket=bucket_name, Prefix=prefix)
+    for obj in resp.get("Contents", []):
+        rel = os.path.relpath(obj["Key"], prefix) if prefix else obj["Key"]
+        dst = os.path.join(target, rel)
+        os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
+        s3.download_file(bucket_name, obj["Key"], dst)
+    return target
