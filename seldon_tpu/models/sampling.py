@@ -62,3 +62,20 @@ def sample(
 
     sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
+
+
+def sample_per_row(
+    logits: jnp.ndarray,  # [B, V]
+    keys: jax.Array,  # [B] PRNG keys (one per row)
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Row-independent sampling: each row draws from its own key, so a
+    request's tokens are reproducible from (seed, position) no matter what
+    other requests share the batch (continuous-batching requirement)."""
+
+    def one(l, k, t, tk, tp):
+        return sample(l[None], k, t[None], tk[None], tp[None])[0]
+
+    return jax.vmap(one)(logits, keys, temperature, top_k, top_p)

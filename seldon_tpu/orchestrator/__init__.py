@@ -1,0 +1,33 @@
+"""Graph orchestrator ("engine") — the request-path hot loop.
+
+Reference: the Java svc-orch (/root/reference/engine/src/main/java/io/seldon/
+engine/, SURVEY.md §2.3): a per-predictor process that walks the inference
+graph at request time, calling each predictive unit over gRPC/REST, merging
+Meta (tags / routing / requestPath / metrics) at every hop, with feedback
+routed back down the recorded path.
+
+TPU-native redesign:
+ * asyncio single-process event loop instead of Spring @Async thread pools —
+   fan-out over graph branches is `asyncio.gather`, unit calls are
+   grpc.aio / aiohttp with cached channels.
+ * Dynamic micro-batching at MODEL leaves (batcher.py): many in-flight
+   requests fuse into one leaf call (BatchIndex framing) so the TPU sees
+   MXU-sized batches. The reference has no batching at all.
+ * DenseTensor protobuf end-to-end — no per-hop JSON codec tax.
+"""
+
+from seldon_tpu.orchestrator.spec import (
+    PredictiveUnit,
+    PredictorSpec,
+    UnitType,
+    load_predictor_spec,
+)
+from seldon_tpu.orchestrator.walker import PredictorEngine
+
+__all__ = [
+    "PredictiveUnit",
+    "PredictorSpec",
+    "UnitType",
+    "load_predictor_spec",
+    "PredictorEngine",
+]

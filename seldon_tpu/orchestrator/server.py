@@ -1,0 +1,279 @@
+"""Engine external API: REST + gRPC + admin surface.
+
+Reference: RestClientController.java (/api/v0.1/predictions, /feedback,
+/ping, /ready, /live, /pause, /unpause) + SeldonGrpcServer/SeldonService
+(gRPC Seldon.Predict/SendFeedback) + Micrometer /prometheus
+(SURVEY.md §2.3). One asyncio process serves all of it."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import socket
+import time
+from typing import Optional
+
+import grpc
+import grpc.aio
+from aiohttp import web
+
+from seldon_tpu.core import payloads
+from seldon_tpu.orchestrator.batcher import MicroBatcher
+from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
+from seldon_tpu.orchestrator.spec import (
+    HARDCODED_IMPLEMENTATIONS,
+    PredictorSpec,
+    load_predictor_spec,
+)
+from seldon_tpu.orchestrator.walker import PredictorEngine
+from seldon_tpu.proto import prediction_grpc
+from seldon_tpu.proto import prediction_pb2 as pb
+from seldon_tpu.runtime.metrics_server import ServerMetrics, get_default_metrics
+
+logger = logging.getLogger(__name__)
+
+PROTO_CONTENT_TYPE = "application/x-protobuf"
+
+
+class GraphReadyChecker:
+    """Recursive TCP ping of every microservice endpoint (reference
+    SeldonGraphReadyChecker.java:40-80: 3 attempts x 500ms)."""
+
+    def __init__(self, spec: PredictorSpec, attempts: int = 3,
+                 timeout_s: float = 0.5):
+        self.endpoints = [
+            (u.endpoint.service_host, u.endpoint.service_port)
+            for u in spec.graph.walk()
+            if u.endpoint is not None
+            and u.implementation not in HARDCODED_IMPLEMENTATIONS
+        ]
+        self.attempts = attempts
+        self.timeout_s = timeout_s
+
+    async def ready(self) -> bool:
+        for host, port in self.endpoints:
+            ok = False
+            for _ in range(self.attempts):
+                try:
+                    _, writer = await asyncio.wait_for(
+                        asyncio.open_connection(host, port), self.timeout_s
+                    )
+                    writer.close()
+                    ok = True
+                    break
+                except (OSError, asyncio.TimeoutError):
+                    await asyncio.sleep(0.05)
+            if not ok:
+                return False
+        return True
+
+
+class EngineServer:
+    """The per-predictor orchestrator process."""
+
+    def __init__(
+        self,
+        spec: Optional[PredictorSpec] = None,
+        http_port: int = 8000,
+        grpc_port: int = 5001,
+        enable_batching: bool = True,
+        metrics: Optional[ServerMetrics] = None,
+    ):
+        self.spec = spec or load_predictor_spec()
+        self.http_port = http_port
+        self.grpc_port = grpc_port
+        self.metrics = metrics or get_default_metrics()
+        self.batcher = MicroBatcher() if enable_batching else None
+        self.engine = PredictorEngine(
+            self.spec,
+            batcher=self.batcher,
+            metrics_hook=self._on_custom_metric,
+        )
+        self.ready_checker = GraphReadyChecker(self.spec)
+        self.paused = False  # /pause drains traffic before pod kill
+        self._grpc_server: Optional[grpc.aio.Server] = None
+        self._runner: Optional[web.AppRunner] = None
+
+    def _on_custom_metric(self, metric: pb.Metric, unit) -> None:
+        self.metrics.record_custom([metric])
+
+    # --- REST ---------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+
+        async def parse(request: web.Request, cls):
+            ctype = request.headers.get("Content-Type", "")
+            if ctype.startswith(PROTO_CONTENT_TYPE):
+                return cls.FromString(await request.read()), "proto"
+            if ctype.startswith("application/json"):
+                return payloads.dict_to_message(await request.json(), cls), "json"
+            form = await request.post()
+            raw = form.get("json")
+            if raw is None:
+                raise web.HTTPBadRequest(text="no json payload")
+            return payloads.dict_to_message(json.loads(raw), cls), "json"
+
+        def reply(msg, encoding):
+            if encoding == "proto":
+                return web.Response(
+                    body=msg.SerializeToString(),
+                    content_type=PROTO_CONTENT_TYPE,
+                )
+            return web.json_response(payloads.message_to_dict(msg))
+
+        async def predictions(request: web.Request) -> web.Response:
+            if self.paused:
+                return web.json_response({"error": "paused"}, status=503)
+            t0 = time.perf_counter()
+            try:
+                msg, enc = await parse(request, pb.SeldonMessage)
+            except web.HTTPBadRequest:
+                raise
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+            try:
+                out = await self.engine.predict(msg)
+            except UnitCallError as e:
+                return web.json_response(
+                    {"status": {"status": 1, "info": str(e), "code": -1,
+                                "reason": "ENGINE_UNIT_FAILURE"}},
+                    status=500,
+                )
+            self.metrics.observe("predictions", "rest",
+                                 time.perf_counter() - t0, out)
+            return reply(out, enc)
+
+        async def feedback(request: web.Request) -> web.Response:
+            t0 = time.perf_counter()
+            try:
+                fb, enc = await parse(request, pb.Feedback)
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+            out = await self.engine.send_feedback(fb)
+            self.metrics.observe("feedback", "rest",
+                                 time.perf_counter() - t0, out)
+            return reply(out, enc)
+
+        async def ready(request: web.Request) -> web.Response:
+            if self.paused:
+                return web.Response(status=503, text="paused")
+            if await self.ready_checker.ready():
+                return web.Response(text="ready")
+            return web.Response(status=503, text="graph not ready")
+
+        async def live(request: web.Request) -> web.Response:
+            return web.Response(text="live")
+
+        async def pause(request: web.Request) -> web.Response:
+            self.paused = True
+            return web.Response(text="paused")
+
+        async def unpause(request: web.Request) -> web.Response:
+            self.paused = False
+            return web.Response(text="unpaused")
+
+        async def metrics_handler(request: web.Request) -> web.Response:
+            body, ctype = self.metrics.export()
+            return web.Response(body=body, content_type=ctype.split(";")[0])
+
+        app.router.add_post("/api/v0.1/predictions", predictions)
+        app.router.add_post("/api/v1.0/predictions", predictions)
+        app.router.add_post("/predict", predictions)
+        app.router.add_post("/api/v0.1/feedback", feedback)
+        app.router.add_post("/api/v1.0/feedback", feedback)
+        app.router.add_get("/ping", live)
+        app.router.add_get("/live", live)
+        app.router.add_get("/ready", ready)
+        app.router.add_get("/pause", pause)
+        app.router.add_post("/pause", pause)
+        app.router.add_get("/unpause", unpause)
+        app.router.add_post("/unpause", unpause)
+        app.router.add_get("/prometheus", metrics_handler)
+        app.router.add_get("/metrics", metrics_handler)
+        return app
+
+    # --- gRPC ---------------------------------------------------------------
+
+    class _SeldonServicer:
+        def __init__(self, outer: "EngineServer"):
+            self.outer = outer
+
+        async def Predict(self, request, context):
+            if self.outer.paused:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
+            t0 = time.perf_counter()
+            try:
+                out = await self.outer.engine.predict(request)
+            except UnitCallError as e:
+                await context.abort(grpc.StatusCode.INTERNAL, str(e))
+                return
+            self.outer.metrics.observe(
+                "predictions", "grpc", time.perf_counter() - t0, out
+            )
+            return out
+
+        async def SendFeedback(self, request, context):
+            return await self.outer.engine.send_feedback(request)
+
+    async def start(self, host: str = "0.0.0.0"):
+        app = self.build_app()
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, self.http_port)
+        await site.start()
+        self.http_port = site._server.sockets[0].getsockname()[1]
+
+        self._grpc_server = grpc.aio.server(
+            options=[
+                ("grpc.max_send_message_length", 512 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+            ]
+        )
+        prediction_grpc.add_servicer(
+            self._grpc_server, "Seldon", self._SeldonServicer(self)
+        )
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"{host}:{self.grpc_port}"
+        )
+        await self._grpc_server.start()
+        logger.info(
+            "engine up: http=%d grpc=%d graph=%s",
+            self.http_port, self.grpc_port, self.spec.graph.name,
+        )
+
+    async def stop(self):
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=1.0)
+        if self._runner is not None:
+            await self._runner.cleanup()
+        await self.engine.close()
+
+
+def main():  # pragma: no cover - CLI entry
+    import argparse
+
+    parser = argparse.ArgumentParser(description="seldon-tpu engine")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--grpc-port", type=int, default=5001)
+    parser.add_argument("--no-batching", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    server = EngineServer(
+        http_port=args.http_port,
+        grpc_port=args.grpc_port,
+        enable_batching=not args.no_batching,
+    )
+
+    async def run():
+        await server.start()
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,284 @@
+"""The graph walk — engine hot loop.
+
+Reference algorithm (engine/.../predictors/PredictiveUnitBean.java:106-199,
+the forward path; :201-246 the feedback mirror):
+
+  1. record requestPath[unit] = image
+  2. transformInput (== predict for MODEL units)
+  3. leaf -> return
+  4. route -> branch index (-1 = broadcast to all children)
+  5. fan out children (async)
+  6. aggregate children outputs (COMBINER)
+  7. transformOutput (OUTPUT_TRANSFORMER)
+  merging Meta tags/puid at each hop, accumulating routing{} and metrics.
+
+Redesign: one asyncio task tree instead of Spring @Async thread pools;
+per-request context object accumulates meta (the reference threads
+ConcurrentHashMaps through the recursion); MODEL leaf calls can flow
+through the dynamic micro-batcher (batcher.py) — reference has none."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+import secrets
+import time
+from typing import Dict, List, Optional
+
+from google.protobuf import json_format
+
+from seldon_tpu.core import payloads
+from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
+from seldon_tpu.orchestrator.spec import (
+    HARDCODED_IMPLEMENTATIONS,
+    PredictiveUnit,
+    PredictorSpec,
+    UnitType,
+)
+from seldon_tpu.orchestrator.units import make_hardcoded
+from seldon_tpu.proto import prediction_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+
+def make_puid() -> str:
+    """Random request id (reference: SecureRandom base32,
+    PredictionService.java:80-92)."""
+    return base64.b32encode(secrets.token_bytes(15)).decode().lower()
+
+
+class _RequestCtx:
+    """Per-request accumulators threaded through the walk (reference
+    PredictiveUnitBean.java:74-76 ConcurrentHashMaps)."""
+
+    def __init__(self, puid: str):
+        self.puid = puid
+        self.tags: Dict[str, object] = {}
+        self.routing: Dict[str, int] = {}
+        self.request_path: Dict[str, str] = {}
+        self.metrics: List[pb.Metric] = []
+        self.lock = asyncio.Lock()
+
+    async def merge_response_meta(self, meta: pb.Meta) -> None:
+        async with self.lock:
+            for k, v in meta.tags.items():
+                self.tags[k] = v
+            self.metrics.extend(meta.metrics)
+
+    def stamp(self, meta: pb.Meta) -> None:
+        meta.puid = self.puid
+        for k, v in self.tags.items():
+            if isinstance(v, type(meta.tags[k])):
+                meta.tags[k].CopyFrom(v)
+            else:
+                json_format.ParseDict(v, meta.tags[k])
+        for k, i in self.routing.items():
+            meta.routing[k] = i
+        for k, v in self.request_path.items():
+            meta.requestPath[k] = v
+        for m in self.metrics:
+            meta.metrics.add().CopyFrom(m)
+
+
+class PredictorEngine:
+    """Walks one PredictorSpec graph."""
+
+    def __init__(
+        self,
+        spec: PredictorSpec,
+        client: Optional[InternalClient] = None,
+        batcher=None,
+        metrics_hook=None,
+    ):
+        self.spec = spec
+        self.client = client or InternalClient()
+        self.batcher = batcher
+        self.metrics_hook = metrics_hook  # callable(metric: pb.Metric, unit)
+        self._hardcoded = {
+            u.name: make_hardcoded(u.implementation, u.parameters)
+            for u in spec.graph.walk()
+            if u.implementation in HARDCODED_IMPLEMENTATIONS
+        }
+
+    # --- forward path -------------------------------------------------------
+
+    async def predict(self, request: pb.SeldonMessage) -> pb.SeldonMessage:
+        puid = request.meta.puid or make_puid()
+        ctx = _RequestCtx(puid)
+        msg = pb.SeldonMessage()
+        msg.CopyFrom(request)
+        msg.meta.puid = puid
+        out = await self._get_output(msg, self.spec.graph, ctx)
+        resp = pb.SeldonMessage()
+        resp.CopyFrom(out)
+        resp.meta.Clear()
+        ctx.stamp(resp.meta)
+        return resp
+
+    async def _get_output(
+        self, msg: pb.SeldonMessage, unit: PredictiveUnit, ctx: _RequestCtx
+    ) -> pb.SeldonMessage:
+        ctx.request_path[unit.name] = unit.image or unit.name
+        hard = self._hardcoded.get(unit.name)
+
+        # (2) transformInput / predict
+        transformed = await self._transform_input(msg, unit, hard, ctx)
+
+        # (3) leaf
+        if not unit.children:
+            return transformed
+
+        # (4) route
+        branch = await self._route(transformed, unit, hard, ctx)
+
+        # (5) children fan-out
+        if branch == -1:
+            selected = unit.children
+        else:
+            if branch >= len(unit.children):
+                raise UnitCallError(
+                    unit.name, "route",
+                    f"branch {branch} out of range ({len(unit.children)} children)",
+                )
+            selected = [unit.children[branch]]
+        child_outputs = await asyncio.gather(
+            *(self._get_output(transformed, c, ctx) for c in selected)
+        )
+
+        # (6) aggregate
+        merged = await self._aggregate(list(child_outputs), unit, hard, ctx)
+
+        # (7) transformOutput
+        return await self._transform_output(merged, unit, hard, ctx)
+
+    async def _transform_input(
+        self, msg, unit: PredictiveUnit, hard, ctx
+    ) -> pb.SeldonMessage:
+        if unit.type == UnitType.MODEL:
+            if hard is not None:
+                out = hard.transform_input(msg)
+            elif self.batcher is not None:
+                out = await self.batcher.call(unit, msg, self.client)
+            else:
+                out = await self.client.call(unit, "predict", msg)
+        elif unit.type == UnitType.TRANSFORMER:
+            if hard is not None:
+                out = hard.transform_input(msg)
+            else:
+                out = await self.client.call(unit, "transform_input", msg)
+        else:
+            return msg
+        await self._absorb(out, unit, ctx)
+        return out
+
+    async def _route(self, msg, unit: PredictiveUnit, hard, ctx) -> int:
+        if unit.type != UnitType.ROUTER:
+            return -1
+        if hard is not None:
+            branch = hard.route(msg, len(unit.children))
+        else:
+            resp = await self.client.call(unit, "route", msg)
+            branch = _extract_route(resp)
+            await self._absorb(resp, unit, ctx)
+        async with ctx.lock:
+            ctx.routing[unit.name] = branch
+        return branch
+
+    async def _aggregate(
+        self, outputs: List[pb.SeldonMessage], unit: PredictiveUnit, hard, ctx
+    ) -> pb.SeldonMessage:
+        if unit.type == UnitType.COMBINER:
+            if hard is not None:
+                out = hard.aggregate(outputs)
+            else:
+                req = pb.SeldonMessageList()
+                req.seldonMessages.extend(outputs)
+                out = await self.client.call(unit, "aggregate", req)
+            await self._absorb(out, unit, ctx)
+            return out
+        if len(outputs) == 1:
+            return outputs[0]
+        raise UnitCallError(
+            unit.name, "aggregate",
+            f"{len(outputs)} child outputs but unit is not a COMBINER",
+        )
+
+    async def _transform_output(self, msg, unit: PredictiveUnit, hard, ctx):
+        if unit.type != UnitType.OUTPUT_TRANSFORMER:
+            return msg
+        if hard is not None:
+            out = hard.transform_output(msg)
+        else:
+            out = await self.client.call(unit, "transform_output", msg)
+        await self._absorb(out, unit, ctx)
+        return out
+
+    async def _absorb(self, out: pb.SeldonMessage, unit: PredictiveUnit, ctx):
+        """Merge a unit response's meta into the request context; surface
+        custom metrics (reference PredictiveUnitBean.java:334-357)."""
+        await ctx.merge_response_meta(out.meta)
+        if self.metrics_hook is not None:
+            for m in out.meta.metrics:
+                self.metrics_hook(m, unit)
+
+    # --- feedback mirror ----------------------------------------------------
+
+    async def send_feedback(self, feedback: pb.Feedback) -> pb.SeldonMessage:
+        """Follows stored meta.routing down the tree (reference
+        PredictiveUnitBean.java:206-246)."""
+        await self._send_feedback(feedback, self.spec.graph)
+        resp = pb.SeldonMessage()
+        resp.meta.puid = feedback.response.meta.puid or make_puid()
+        return resp
+
+    async def _send_feedback(self, feedback: pb.Feedback, unit: PredictiveUnit):
+        hard = self._hardcoded.get(unit.name)
+        if unit.type in (UnitType.MODEL, UnitType.ROUTER):
+            if hard is not None:
+                hard.send_feedback(feedback)
+            else:
+                try:
+                    await self.client.call(unit, "send_feedback", feedback)
+                except UnitCallError:
+                    logger.warning("feedback to %s failed", unit.name,
+                                   exc_info=True)
+            if self.metrics_hook is not None:
+                reward = pb.Metric(
+                    key="seldon_api_model_feedback_reward",
+                    type=pb.Metric.COUNTER,
+                    value=feedback.reward,
+                )
+                self.metrics_hook(reward, unit)
+        routing = feedback.response.meta.routing
+        if unit.name in routing:
+            branch = routing[unit.name]
+            children = (
+                unit.children if branch == -1
+                else [unit.children[branch]]
+                if 0 <= branch < len(unit.children)
+                else []
+            )
+        else:
+            children = unit.children
+        await asyncio.gather(
+            *(self._send_feedback(feedback, c) for c in children)
+        )
+
+    async def close(self):
+        await self.client.close()
+
+
+def _extract_route(msg: pb.SeldonMessage) -> int:
+    """Routers return the branch as the first element of their data payload
+    (reference RoutingUtils semantics)."""
+    data = payloads.get_data_from_message(msg)
+    try:
+        import numpy as np
+
+        arr = np.asarray(data).ravel()
+        if arr.size == 0:
+            return -1
+        return int(arr[0])
+    except (TypeError, ValueError):
+        return -1

@@ -35,7 +35,7 @@ import numpy as np
 
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
-from seldon_tpu.models.sampling import SamplingParams, sample
+from seldon_tpu.models.sampling import SamplingParams, sample, sample_per_row
 
 logger = logging.getLogger(__name__)
 
@@ -44,7 +44,6 @@ logger = logging.getLogger(__name__)
 class EngineConfig:
     max_slots: int = 8
     max_seq_len: int = 2048
-    default_max_new_tokens: int = 128
     prompt_buckets: Sequence[int] = (32, 128, 512, 1024)
     idle_sleep_s: float = 0.002
 
@@ -109,6 +108,12 @@ class InferenceEngine:
         self._temp = jnp.ones((B,), jnp.float32)
         self._top_k = jnp.zeros((B,), jnp.int32)
         self._top_p = jnp.ones((B,), jnp.float32)
+        self._seeds = jnp.zeros((B,), jnp.uint32)
+
+        # Prompt buckets clamped to the cache window (empty -> whole window).
+        self._buckets = tuple(
+            b for b in self.ecfg.prompt_buckets if b <= Smax
+        ) or (Smax,)
 
         # Host-side bookkeeping.
         self._slots: List[Optional[_Request]] = [None] * B
@@ -116,8 +121,6 @@ class InferenceEngine:
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._rid = 0
         self._rid_lock = threading.Lock()
-        self._key = jax.random.key(0)
-        self._step_count = 0
         self.stats = EngineStats()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -154,11 +157,16 @@ class InferenceEngine:
         return {"k": k, "v": v}
 
     @staticmethod
-    def _decode_impl(params, cache, last_tok, pos, active, key,
+    def _decode_impl(params, cache, last_tok, pos, active, seeds,
                      temp, top_k, top_p, *, cfg):
-        """One iteration over every slot: feed last tokens, sample next."""
+        """One iteration over every slot: feed last tokens, sample next.
+        Each row's key is (seed, position), so completions are reproducible
+        no matter which requests share the batch."""
         logits, cache = transformer.decode_step(params, last_tok, pos, cache, cfg)
-        tok = sample(logits, key, temp, top_k, top_p)
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
+        )(seeds, pos)
+        tok = sample_per_row(logits, keys, temp, top_k, top_p)
         tok = jnp.where(active, tok, cfg.pad_token_id)
         pos = pos + active.astype(jnp.int32)
         return cache, tok, pos
@@ -173,9 +181,7 @@ class InferenceEngine:
         params = params or SamplingParams()
         if len(tokens) == 0:
             raise ValueError("empty prompt")
-        max_prompt = max(
-            b for b in self.ecfg.prompt_buckets if b <= self.ecfg.max_seq_len
-        )
+        max_prompt = max(self._buckets)
         if len(tokens) > max_prompt:
             raise ValueError(
                 f"prompt length {len(tokens)} exceeds max bucket {max_prompt}"
@@ -207,6 +213,7 @@ class InferenceEngine:
 
     def start(self):
         if self._thread is None:
+            self._stop.clear()  # allow stop() -> start() restart
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
@@ -219,14 +226,10 @@ class InferenceEngine:
     # --- scheduler loop -----------------------------------------------------
 
     def _bucket(self, n: int) -> int:
-        for b in self.ecfg.prompt_buckets:
+        for b in self._buckets:
             if n <= b:
-                return min(b, self.ecfg.max_seq_len)
+                return b
         return self.ecfg.max_seq_len
-
-    def _next_key(self) -> jax.Array:
-        self._step_count += 1
-        return jax.random.fold_in(self._key, self._step_count)
 
     def _admit(self) -> None:
         while self._free and not self._pending.empty():
@@ -241,11 +244,13 @@ class InferenceEngine:
             toks[0, : len(req.tokens)] = req.tokens
             plen = jnp.asarray([len(req.tokens)], jnp.int32)
             sp = req.params
+            # First token keyed by (seed, prompt position) — same seed +
+            # same prompt reproduces the completion regardless of traffic.
             first, sub_k, sub_v = self._jit_prefill(
                 self.params,
                 jnp.asarray(toks),
                 plen,
-                jax.random.fold_in(jax.random.key(sp.seed or 0), req.rid),
+                jax.random.fold_in(jax.random.key(sp.seed), len(req.tokens)),
                 jnp.asarray([sp.temperature], jnp.float32),
                 jnp.asarray([sp.top_k], jnp.int32),
                 jnp.asarray([sp.top_p], jnp.float32),
@@ -277,6 +282,9 @@ class InferenceEngine:
             self._temp = self._temp.at[slot].set(sp.temperature)
             self._top_k = self._top_k.at[slot].set(sp.top_k)
             self._top_p = self._top_p.at[slot].set(sp.top_p)
+            self._seeds = self._seeds.at[slot].set(
+                np.uint32(sp.seed & 0xFFFFFFFF)
+            )
 
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]
@@ -303,7 +311,7 @@ class InferenceEngine:
                 self._last_tok,
                 self._pos,
                 self._active,
-                self._next_key(),
+                self._seeds,
                 self._temp,
                 self._top_k,
                 self._top_p,

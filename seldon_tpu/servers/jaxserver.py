@@ -112,6 +112,26 @@ class JAXServer(SeldonComponent):
             )
             self.engine.start()
             self.params = params
+
+            # One compiled scorer for predict() (cfg baked in statically).
+            import functools
+
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            from seldon_tpu.models import transformer as _tf
+
+            def _score(params, toks, *, _cfg):
+                logits = _tf.forward(params, toks, _cfg)
+                lp = _jax.nn.log_softmax(
+                    logits[:, :-1].astype(_jnp.float32), -1
+                )
+                nll = -_jnp.take_along_axis(
+                    lp, toks[:, 1:, None], axis=-1
+                )[..., 0]
+                return nll.mean(axis=-1)
+
+            self._score_fn = _jax.jit(functools.partial(_score, _cfg=cfg))
             self._loaded = True
             logger.info(
                 "JAXServer loaded: cfg=%s mesh=%s slots=%d seq=%d",
@@ -149,12 +169,18 @@ class JAXServer(SeldonComponent):
     # --- text generation ----------------------------------------------------
 
     def _to_sampling(self, request: Dict) -> SamplingParams:
+        # Explicit falsy values are honored (temperature 0.0 = greedy);
+        # only absent/None keys fall back to defaults.
+        def get(key, default):
+            v = request.get(key)
+            return default if v is None else v
+
         return SamplingParams(
-            temperature=float(request.get("temperature") or 0.7),
-            top_k=int(request.get("top_k") or 0),
-            top_p=float(request.get("top_p") or 1.0),
-            max_new_tokens=int(request.get("max_new_tokens") or 16),
-            seed=int(request.get("seed") or 0),
+            temperature=float(get("temperature", 0.7)),
+            top_k=int(get("top_k", 0)),
+            top_p=float(get("top_p", 1.0)),
+            max_new_tokens=int(get("max_new_tokens", 16) or 16),
+            seed=int(get("seed", 0)),
         )
 
     def _prompt_ids(self, request: Dict) -> List[int]:
@@ -213,23 +239,12 @@ class JAXServer(SeldonComponent):
         """Token ids [B, S] -> per-row mean next-token NLL [B] (lower =
         model finds the sequence more likely)."""
         self._ensure_loaded()
-        import jax
         import jax.numpy as jnp
-
-        from seldon_tpu.models import transformer
 
         toks = jnp.asarray(np.asarray(X, dtype=np.int32))
         if toks.ndim == 1:
             toks = toks[None]
-
-        @jax.jit
-        def score(params, toks):
-            logits = transformer.forward(params, toks, self.cfg)
-            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
-            nll = -jnp.take_along_axis(lp, toks[:, 1:, None], axis=-1)[..., 0]
-            return nll.mean(axis=-1)
-
-        return np.asarray(score(self.params, toks))
+        return np.asarray(self._score_fn(self.params, toks))
 
     # --- observability ------------------------------------------------------
 

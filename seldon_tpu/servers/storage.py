@@ -8,8 +8,8 @@ not baked in — gated, with a clear error instead of an import crash)."""
 
 from __future__ import annotations
 
+import hashlib
 import os
-import shutil
 import tempfile
 
 _DOWNLOAD_DIR = os.environ.get("SELDON_TPU_MODEL_DIR", "/mnt/models")
@@ -21,26 +21,43 @@ def download(uri: str, out_dir: str | None = None) -> str:
     if uri.startswith("file://"):
         return uri[len("file://"):]
     if uri.startswith("gs://"):
-        return _download_gcs(uri, out_dir)
+        return _download_gcs(uri, out_dir or _uri_dir(uri))
     if uri.startswith("s3://"):
-        return _download_s3(uri, out_dir)
+        return _download_s3(uri, out_dir or _uri_dir(uri))
     if os.path.exists(uri):
         return uri
     raise ValueError(f"unsupported or missing model uri: {uri!r}")
+
+
+def _uri_dir(uri: str) -> str | None:
+    """Per-URI subdirectory under the shared model dir, so two models in one
+    pod never overwrite each other's files."""
+    digest = hashlib.sha256(uri.encode()).hexdigest()[:16]
+    try:
+        os.makedirs(_DOWNLOAD_DIR, exist_ok=True)
+        return os.path.join(_DOWNLOAD_DIR, digest)
+    except OSError:
+        return None
 
 
 def _target_dir(out_dir: str | None) -> str:
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
         return out_dir
-    base = _DOWNLOAD_DIR if os.access(os.path.dirname(_DOWNLOAD_DIR) or "/", os.W_OK) else None
-    try:
-        if base:
-            os.makedirs(base, exist_ok=True)
-            return base
-    except OSError:
-        pass
     return tempfile.mkdtemp(prefix="seldon-tpu-model-")
+
+
+def _relative_key(key: str, prefix: str) -> str | None:
+    """Path of `key` under `prefix`, or None if key is outside it (guards
+    against 'models/a' string-matching 'models/ab/...')."""
+    if not prefix:
+        return key
+    p = prefix.rstrip("/")
+    if key == p:
+        return os.path.basename(key)
+    if key.startswith(p + "/"):
+        return key[len(p) + 1:]
+    return None
 
 
 def _download_gcs(uri: str, out_dir: str | None) -> str:
@@ -55,7 +72,9 @@ def _download_gcs(uri: str, out_dir: str | None) -> str:
     target = _target_dir(out_dir)
     client = gcs.Client()
     for blob in client.bucket(bucket_name).list_blobs(prefix=prefix):
-        rel = os.path.relpath(blob.name, prefix) if prefix else blob.name
+        rel = _relative_key(blob.name, prefix)
+        if rel is None:
+            continue
         dst = os.path.join(target, rel)
         os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
         blob.download_to_filename(dst)
@@ -75,10 +94,13 @@ def _download_s3(uri: str, out_dir: str | None) -> str:
     s3 = boto3.client(
         "s3", endpoint_url=os.environ.get("AWS_ENDPOINT_URL") or None
     )
-    resp = s3.list_objects_v2(Bucket=bucket_name, Prefix=prefix)
-    for obj in resp.get("Contents", []):
-        rel = os.path.relpath(obj["Key"], prefix) if prefix else obj["Key"]
-        dst = os.path.join(target, rel)
-        os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
-        s3.download_file(bucket_name, obj["Key"], dst)
+    paginator = s3.get_paginator("list_objects_v2")
+    for page in paginator.paginate(Bucket=bucket_name, Prefix=prefix):
+        for obj in page.get("Contents", []):
+            rel = _relative_key(obj["Key"], prefix)
+            if rel is None:
+                continue
+            dst = os.path.join(target, rel)
+            os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
+            s3.download_file(bucket_name, obj["Key"], dst)
     return target

@@ -162,3 +162,78 @@ def test_checkpoint_roundtrip(tmp_path):
     flat2 = jax.tree.leaves(params2)
     for a, b in zip(flat1, flat2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_seed_reproducible_across_traffic(engine):
+    """Same (seed, prompt) must reproduce the completion regardless of what
+    else shares the batch (per-row position-keyed sampling)."""
+    sp = SamplingParams(temperature=1.0, max_new_tokens=6, seed=42)
+    solo = engine.generate_blocking([5, 6, 7], sp)
+    # Re-run with 3 noisy co-scheduled requests.
+    noise = [
+        engine.submit([9, 9], SamplingParams(temperature=1.0, max_new_tokens=6,
+                                             seed=i))
+        for i in range(3)
+    ]
+    busy = engine.generate_blocking([5, 6, 7], sp)
+    for q_ in noise:
+        while q_.get(timeout=60) is not None:
+            pass
+    assert solo["token_ids"] == busy["token_ids"]
+
+
+def test_engine_restart():
+    import jax
+
+    from seldon_tpu.models import init_params
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = InferenceEngine(
+        params, cfg, EngineConfig(max_slots=2, max_seq_len=32,
+                                  prompt_buckets=(8,))
+    )
+    eng.start()
+    r1 = eng.generate_blocking([3, 4], SamplingParams(temperature=0.0,
+                                                      max_new_tokens=3))
+    eng.stop()
+    eng.start()
+    r2 = eng.generate_blocking([3, 4], SamplingParams(temperature=0.0,
+                                                      max_new_tokens=3))
+    eng.stop()
+    assert r1["token_ids"] == r2["token_ids"]
+
+
+def test_engine_buckets_clamped_to_window():
+    import jax
+
+    from seldon_tpu.models import init_params
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    # No bucket fits the window: engine must clamp, not crash on submit.
+    eng = InferenceEngine(
+        params, cfg, EngineConfig(max_slots=2, max_seq_len=16,
+                                  prompt_buckets=(32, 128))
+    )
+    eng.start()
+    r = eng.generate_blocking([3, 4], SamplingParams(temperature=0.0,
+                                                     max_new_tokens=2))
+    eng.stop()
+    assert len(r["token_ids"]) >= 1
+
+
+def test_jaxserver_explicit_greedy(server):
+    """temperature=0.0 must be honored (not replaced by a default)."""
+    a = server.generate({"prompt": "zz", "max_new_tokens": 4, "temperature": 0.0})
+    b = server.generate({"prompt": "zz", "max_new_tokens": 4, "temperature": 0.0})
+    assert a["token_ids"] == b["token_ids"]
+
+
+def test_storage_relative_key():
+    from seldon_tpu.servers.storage import _relative_key
+
+    assert _relative_key("models/a/x.bin", "models/a") == "x.bin"
+    assert _relative_key("models/ab/x.bin", "models/a") is None
+    assert _relative_key("models/a", "models/a") == "a"
+    assert _relative_key("k", "") == "k"

@@ -1,0 +1,418 @@
+"""Orchestrator tests: graph semantics (mirrors reference engine unit tests,
+SURVEY.md §4 — hardcoded impls, no microservices) plus end-to-end walks
+against real in-process unit servers (fixed-output model trick from
+testing/docker/fixed-model)."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_tpu.core import payloads
+from seldon_tpu.orchestrator.batcher import MicroBatcher
+from seldon_tpu.orchestrator.spec import (
+    PredictorSpec,
+    PredictiveUnit,
+    default_unit_types,
+    load_predictor_spec,
+    validate_spec,
+)
+from seldon_tpu.orchestrator.server import EngineServer, GraphReadyChecker
+from seldon_tpu.orchestrator.walker import PredictorEngine
+from seldon_tpu.proto import prediction_pb2 as pb
+from seldon_tpu.runtime.wrapper import build_grpc_server
+
+
+def spec_from(d) -> PredictorSpec:
+    s = PredictorSpec.from_dict(d)
+    default_unit_types(s.graph)
+    return s
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# Hardcoded graphs (no network)
+# ---------------------------------------------------------------------------
+
+
+def test_simple_model_graph():
+    spec = spec_from(
+        {"name": "p", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+    )
+    eng = PredictorEngine(spec)
+    req = payloads.build_message(np.array([[1.0, 2.0]]), kind="ndarray")
+    out = run(eng.predict(req))
+    arr = payloads.get_data_from_message(out)
+    np.testing.assert_allclose(arr, [[0.9, 0.05, 0.05]])
+    assert out.meta.requestPath["m"] == "m"
+    assert out.meta.puid
+
+
+def test_abtest_routing_and_request_path():
+    spec = spec_from(
+        {
+            "name": "p",
+            "graph": {
+                "name": "ab",
+                "implementation": "RANDOM_ABTEST",
+                "children": [
+                    {"name": "a", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+    )
+    eng = PredictorEngine(spec)
+    branches = set()
+    for i in range(40):
+        req = payloads.build_message(np.array([[1.0]]), kind="ndarray")
+        req.meta.puid = f"req-{i}"
+        out = run(eng.predict(req))
+        b = out.meta.routing["ab"]
+        branches.add(b)
+        # requestPath contains only the taken branch.
+        taken = "a" if b == 0 else "b"
+        other = "b" if b == 0 else "a"
+        assert taken in out.meta.requestPath
+        assert other not in out.meta.requestPath
+        # Same puid must route identically (deterministic hash).
+        out2 = run(eng.predict(req))
+        assert out2.meta.routing["ab"] == b
+    assert branches == {0, 1}  # both branches exercised over 40 puids
+
+
+def test_average_combiner_graph():
+    spec = spec_from(
+        {
+            "name": "p",
+            "graph": {
+                "name": "c",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [
+                    {"name": "a", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+    )
+    eng = PredictorEngine(spec)
+    out = run(eng.predict(payloads.build_message(np.array([[1.0]]), kind="ndarray")))
+    arr = payloads.get_data_from_message(out)
+    np.testing.assert_allclose(arr, [[0.9, 0.05, 0.05]])  # mean of identical
+    assert set(out.meta.requestPath) == {"c", "a", "b"}
+
+
+def test_validate_spec_catches_bad_graphs():
+    bad = PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "r", "type": "ROUTER"}}
+    )
+    problems = validate_spec(bad)
+    assert any("no children" in p for p in problems)
+    dup = spec_from(
+        {
+            "name": "p",
+            "graph": {
+                "name": "x",
+                "implementation": "SIMPLE_MODEL",
+                "children": [{"name": "x", "implementation": "SIMPLE_MODEL"}],
+            },
+        }
+    )
+    assert any("duplicate" in p for p in validate_spec(dup))
+
+
+def test_load_predictor_spec_from_env(monkeypatch):
+    import base64
+    import json
+
+    d = {"name": "p", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+    monkeypatch.setenv(
+        "ENGINE_PREDICTOR", base64.b64encode(json.dumps(d).encode()).decode()
+    )
+    spec = load_predictor_spec()
+    assert spec.graph.name == "m"
+
+
+# ---------------------------------------------------------------------------
+# Real microservice units over sockets (fixed-output model trick)
+# ---------------------------------------------------------------------------
+
+
+class FixedModel:
+    """Reference testing/docker/fixed-model/ModelV1.py analogue."""
+
+    def __init__(self, values, image="fixed:0.1"):
+        self.values = np.asarray(values, dtype=np.float64)
+        self.image = image
+
+    def predict(self, X, names, meta=None):
+        return np.tile(self.values, (np.asarray(X).shape[0], 1))
+
+    def tags(self):
+        return {"image": self.image}
+
+
+class FixedRouter:
+    def __init__(self, branch):
+        self.branch = branch
+        self.feedback_seen = []
+
+    def route(self, X, names):
+        return self.branch
+
+    def send_feedback(self, features, names, reward, truth, routing=None):
+        self.feedback_seen.append((reward, routing))
+
+
+@pytest.fixture()
+def unit_servers():
+    """Spin up gRPC unit servers; yields {name: (port, user_obj)}."""
+    servers = []
+    units = {}
+
+    def serve(name, obj):
+        srv = build_grpc_server(obj)
+        port = srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        servers.append(srv)
+        units[name] = (port, obj)
+
+    serve("m1", FixedModel([[1, 2, 3, 4]], image="fixed:0.1"))
+    serve("m2", FixedModel([[5, 6, 7, 8]], image="fixed:0.2"))
+    serve("r", FixedRouter(1))
+    yield units
+    for s in servers:
+        s.stop(0)
+
+
+def graph_with_router(units):
+    return spec_from(
+        {
+            "name": "p",
+            "graph": {
+                "name": "router",
+                "type": "ROUTER",
+                "endpoint": {
+                    "service_host": "127.0.0.1",
+                    "service_port": units["r"][0],
+                    "type": "GRPC",
+                },
+                "children": [
+                    {
+                        "name": "m1",
+                        "type": "MODEL",
+                        "image": "fixed:0.1",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": units["m1"][0],
+                            "type": "GRPC",
+                        },
+                    },
+                    {
+                        "name": "m2",
+                        "type": "MODEL",
+                        "image": "fixed:0.2",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": units["m2"][0],
+                            "type": "GRPC",
+                        },
+                    },
+                ],
+            },
+        }
+    )
+
+
+def test_router_graph_over_grpc(unit_servers):
+    eng = PredictorEngine(graph_with_router(unit_servers))
+
+    async def go():
+        req = payloads.build_message(np.array([[1.0, 2.0]]), kind="dense")
+        out = await eng.predict(req)
+        await eng.close()
+        return out
+
+    out = run(go())
+    arr = payloads.get_data_from_message(out)
+    np.testing.assert_allclose(arr, [[5, 6, 7, 8]])  # router sent to m2
+    assert out.meta.routing["router"] == 1
+    assert out.meta.requestPath["m2"] == "fixed:0.2"
+    assert "m1" not in out.meta.requestPath
+    # tags from the serving unit propagate
+    assert out.meta.tags["image"].string_value == "fixed:0.2"
+
+
+def test_feedback_follows_routing(unit_servers):
+    eng = PredictorEngine(graph_with_router(unit_servers))
+
+    async def go():
+        fb = pb.Feedback()
+        fb.reward = 0.75
+        fb.response.meta.puid = "x"
+        fb.response.meta.routing["router"] = 1
+        fb.request.CopyFrom(
+            payloads.build_message(np.array([[1.0]]), kind="dense")
+        )
+        await eng.send_feedback(fb)
+        await eng.close()
+
+    run(go())
+    router_obj = unit_servers["r"][1]
+    assert router_obj.feedback_seen, "router should receive feedback"
+    assert router_obj.feedback_seen[0][0] == 0.75
+
+
+def test_combiner_over_microservices(unit_servers):
+    spec = spec_from(
+        {
+            "name": "p",
+            "graph": {
+                "name": "comb",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [
+                    {
+                        "name": "m1",
+                        "type": "MODEL",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": unit_servers["m1"][0],
+                            "type": "GRPC",
+                        },
+                    },
+                    {
+                        "name": "m2",
+                        "type": "MODEL",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": unit_servers["m2"][0],
+                            "type": "GRPC",
+                        },
+                    },
+                ],
+            },
+        }
+    )
+    eng = PredictorEngine(spec)
+
+    async def go():
+        out = await eng.predict(
+            payloads.build_message(np.array([[0.0]]), kind="dense")
+        )
+        await eng.close()
+        return out
+
+    out = run(go())
+    arr = payloads.get_data_from_message(out)
+    np.testing.assert_allclose(arr, [[3, 4, 5, 6]])  # mean of [1..4],[5..8]
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher
+# ---------------------------------------------------------------------------
+
+
+class CountingModel:
+    def __init__(self):
+        self.calls = 0
+        self.rows = []
+
+    def predict(self, X, names, meta=None):
+        X = np.asarray(X)
+        self.calls += 1
+        self.rows.append(X.shape[0])
+        return X * 2.0
+
+
+def test_batcher_fuses_concurrent_requests():
+    obj = CountingModel()
+    srv = build_grpc_server(obj)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        unit = PredictiveUnit.from_dict(
+            {
+                "name": "m",
+                "type": "MODEL",
+                "endpoint": {
+                    "service_host": "127.0.0.1",
+                    "service_port": port,
+                    "type": "GRPC",
+                },
+            }
+        )
+        from seldon_tpu.orchestrator.client import InternalClient
+
+        async def go():
+            batcher = MicroBatcher(max_batch_size=64, window_ms=20.0)
+            client = InternalClient()
+            reqs = [
+                payloads.build_message(
+                    np.full((1, 3), float(i)), kind="dense"
+                )
+                for i in range(8)
+            ]
+            for i, r in enumerate(reqs):
+                r.meta.puid = f"p{i}"
+            outs = await asyncio.gather(
+                *(batcher.call(unit, r, client) for r in reqs)
+            )
+            await client.close()
+            return outs, batcher
+
+        outs, batcher = run(go())
+        # All 8 requests answered correctly (row i doubled).
+        for i, o in enumerate(outs):
+            arr = payloads.get_data_from_message(o)
+            np.testing.assert_allclose(arr, np.full((1, 3), 2.0 * i))
+            assert o.meta.puid == f"p{i}"
+        # They fused into far fewer leaf calls than 8.
+        assert obj.calls < 8
+        assert batcher.stats["fused_calls"] >= 1
+    finally:
+        srv.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# Engine server (REST external surface)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_server_rest_roundtrip():
+    spec = spec_from(
+        {"name": "p", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+    )
+
+    async def go():
+        import aiohttp
+
+        server = EngineServer(spec=spec, http_port=0, grpc_port=0)
+        await server.start(host="127.0.0.1")
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{server.http_port}"
+            body = {"data": {"ndarray": [[1.0, 2.0]]}}
+            async with s.post(f"{url}/api/v0.1/predictions", json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+            async with s.get(f"{url}/ready") as r:
+                ready_status = r.status
+            async with s.get(f"{url}/pause") as r:
+                assert r.status == 200
+            async with s.post(f"{url}/api/v0.1/predictions", json=body) as r:
+                paused_status = r.status
+            async with s.get(f"{url}/unpause") as r:
+                assert r.status == 200
+            async with s.get(f"{url}/prometheus") as r:
+                prom = await r.text()
+        await server.stop()
+        return out, ready_status, paused_status, prom
+
+    out, ready_status, paused_status, prom = run(go())
+    assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+    assert ready_status == 200
+    assert paused_status == 503
+    assert "engine" in prom or "seldon" in prom or prom  # prometheus text
