@@ -1,0 +1,43 @@
+"""Shared HTTP content negotiation for the proto/JSON dual REST surface.
+
+One definition of the proto content type and the request-parse/response-
+serialize logic, used by both the unit wrapper (runtime/wrapper.py) and the
+engine server (orchestrator/server.py)."""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from seldon_tpu.core import payloads
+
+PROTO_CONTENT_TYPE = "application/x-protobuf"
+
+
+async def parse_message(request: web.Request, req_cls):
+    """-> (proto message, encoding 'proto'|'json'). Accepts binary proto,
+    JSON bodies, form `json=` fields, and GET `?json=` query params."""
+    ctype = request.headers.get("Content-Type", "")
+    if ctype.startswith(PROTO_CONTENT_TYPE):
+        return req_cls.FromString(await request.read()), "proto"
+    if request.method == "GET":
+        raw = request.query.get("json")
+        if raw is None:
+            raise ValueError("empty json parameter in request")
+        return payloads.dict_to_message(json.loads(raw), req_cls), "json"
+    if ctype.startswith("application/json"):
+        return payloads.dict_to_message(await request.json(), req_cls), "json"
+    form = await request.post()
+    raw = form.get("json")
+    if raw is None:
+        raise ValueError("no json payload in request")
+    return payloads.dict_to_message(json.loads(raw), req_cls), "json"
+
+
+def reply(msg, encoding: str) -> web.Response:
+    if encoding == "proto":
+        return web.Response(
+            body=msg.SerializeToString(), content_type=PROTO_CONTENT_TYPE
+        )
+    return web.json_response(payloads.message_to_dict(msg))

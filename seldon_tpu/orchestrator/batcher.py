@@ -26,13 +26,14 @@ logger = logging.getLogger(__name__)
 
 
 class _Pending:
-    __slots__ = ("msg", "arr", "future", "puid")
+    __slots__ = ("msg", "arr", "future", "puid", "kind")
 
-    def __init__(self, msg, arr, future, puid):
+    def __init__(self, msg, arr, future, puid, kind):
         self.msg = msg
         self.arr = arr
         self.future = future
         self.puid = puid
+        self.kind = kind
 
 
 class MicroBatcher:
@@ -56,7 +57,9 @@ class MicroBatcher:
         if msg.WhichOneof("data_oneof") != "data":
             return None
         arr = payloads.data_to_array(msg.data)
-        if not isinstance(arr, np.ndarray) or arr.ndim < 1 or arr.dtype.kind not in "fiub":
+        # ndim >= 2 required: a 1-D array is one sample's feature vector,
+        # not a row batch — concatenating those would corrupt semantics.
+        if not isinstance(arr, np.ndarray) or arr.ndim < 2 or arr.dtype.kind not in "fiub":
             return None
         return arr
 
@@ -73,7 +76,10 @@ class MicroBatcher:
 
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        pend = _Pending(msg, arr, fut, msg.meta.puid)
+        pend = _Pending(
+            msg, arr, fut, msg.meta.puid,
+            payloads.data_kind(msg) or "dense",
+        )
         to_exec: List[List[_Pending]] = []
         async with self._lock(unit.name):
             q = self._queues.setdefault(unit.name, [])
@@ -130,9 +136,13 @@ class MicroBatcher:
             return
 
         fused = np.concatenate([p.arr for p in q], axis=0)
-        kind = payloads.data_kind(q[0].msg) or "dense"
+        kind = q[0].kind
         req = payloads.build_message(fused, kind=kind)
         req.meta.puid = q[0].puid or "fused"
+        # Preserve every fused request's tags (later requests win ties).
+        for p in q:
+            for k, v in p.msg.meta.tags.items():
+                req.meta.tags[k].CopyFrom(v)
         bi = pb.BatchIndex(
             puids=[p.puid for p in q],
             row_counts=[p.arr.shape[0] for p in q],
@@ -152,9 +162,10 @@ class MicroBatcher:
             row = 0
             for p in q:
                 n = p.arr.shape[0]
+                # Each request's reply uses ITS OWN payload kind, so the
+                # wire encoding never depends on co-batched traffic.
                 sub = payloads.build_message(
-                    out[row: row + n], names=names,
-                    kind=payloads.data_kind(resp) or kind,
+                    out[row: row + n], names=names, kind=p.kind,
                 )
                 sub.meta.CopyFrom(resp.meta)
                 sub.meta.puid = p.puid

@@ -24,7 +24,7 @@ from seldon_tpu.proto import prediction_pb2 as pb
 
 logger = logging.getLogger(__name__)
 
-PROTO_CONTENT_TYPE = "application/x-protobuf"
+from seldon_tpu.core.http import PROTO_CONTENT_TYPE  # noqa: F401 (shared constant)
 
 # engine-side call name -> (service, rpc) — typed per-unit stubs mirroring
 # the reference (InternalPredictionService.java:269-306).
@@ -118,10 +118,18 @@ class InternalClient:
                 return await self._call_rest(ep, method, request, response_cls)
             except (grpc.aio.AioRpcError, OSError, asyncio.TimeoutError) as e:
                 last_err = e
-                code = getattr(e, "code", lambda: None)()
                 # Only connection-level failures retry (reference retries on
-                # connect failure only, InternalPredictionService.java:413-467).
-                if code not in (None, grpc.StatusCode.UNAVAILABLE):
+                # connect failure only, InternalPredictionService.java:413-467)
+                # — NOT timeouts: the unit may already be doing the work, and
+                # retrying a slow call duplicates it.
+                if isinstance(e, grpc.aio.AioRpcError):
+                    retryable = e.code() == grpc.StatusCode.UNAVAILABLE
+                else:
+                    retryable = isinstance(
+                        e, (ConnectionRefusedError, ConnectionResetError,
+                            ConnectionAbortedError, BrokenPipeError)
+                    )
+                if not retryable:
                     break
                 if attempt < self.retries:
                     await asyncio.sleep(0.05 * (attempt + 1))

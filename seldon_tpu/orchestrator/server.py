@@ -8,9 +8,7 @@ Reference: RestClientController.java (/api/v0.1/predictions, /feedback,
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
-import socket
 import time
 from typing import Optional
 
@@ -19,6 +17,7 @@ import grpc.aio
 from aiohttp import web
 
 from seldon_tpu.core import payloads
+from seldon_tpu.core.http import PROTO_CONTENT_TYPE, parse_message, reply
 from seldon_tpu.orchestrator.batcher import MicroBatcher
 from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
 from seldon_tpu.orchestrator.spec import (
@@ -32,9 +31,6 @@ from seldon_tpu.proto import prediction_pb2 as pb
 from seldon_tpu.runtime.metrics_server import ServerMetrics, get_default_metrics
 
 logger = logging.getLogger(__name__)
-
-PROTO_CONTENT_TYPE = "application/x-protobuf"
-
 
 class GraphReadyChecker:
     """Recursive TCP ping of every microservice endpoint (reference
@@ -102,26 +98,7 @@ class EngineServer:
 
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=1024**3)
-
-        async def parse(request: web.Request, cls):
-            ctype = request.headers.get("Content-Type", "")
-            if ctype.startswith(PROTO_CONTENT_TYPE):
-                return cls.FromString(await request.read()), "proto"
-            if ctype.startswith("application/json"):
-                return payloads.dict_to_message(await request.json(), cls), "json"
-            form = await request.post()
-            raw = form.get("json")
-            if raw is None:
-                raise web.HTTPBadRequest(text="no json payload")
-            return payloads.dict_to_message(json.loads(raw), cls), "json"
-
-        def reply(msg, encoding):
-            if encoding == "proto":
-                return web.Response(
-                    body=msg.SerializeToString(),
-                    content_type=PROTO_CONTENT_TYPE,
-                )
-            return web.json_response(payloads.message_to_dict(msg))
+        parse = parse_message  # shared proto/JSON negotiation (core/http.py)
 
         async def predictions(request: web.Request) -> web.Response:
             if self.paused:

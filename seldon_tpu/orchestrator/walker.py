@@ -271,14 +271,20 @@ class PredictorEngine:
 
 def _extract_route(msg: pb.SeldonMessage) -> int:
     """Routers return the branch as the first element of their data payload
-    (reference RoutingUtils semantics)."""
+    (reference RoutingUtils semantics). A malformed router response is an
+    error, NOT broadcast — silently fanning out to every branch would run
+    all models and mask the router bug."""
+    import numpy as np
+
     data = payloads.get_data_from_message(msg)
     try:
-        import numpy as np
-
         arr = np.asarray(data).ravel()
         if arr.size == 0:
-            return -1
+            raise ValueError("empty payload")
         return int(arr[0])
-    except (TypeError, ValueError):
-        return -1
+    except (TypeError, ValueError) as e:
+        raise UnitCallError(
+            "router", "route",
+            f"malformed route response ({e}); expected branch index as "
+            f"first data element",
+        )

@@ -27,7 +27,8 @@ from typing import Any, Optional
 import grpc
 from aiohttp import web
 
-from seldon_tpu.core import payloads
+from seldon_tpu.core import http, payloads
+from seldon_tpu.core.http import PROTO_CONTENT_TYPE
 from seldon_tpu.proto import prediction_pb2 as pb
 from seldon_tpu.proto import prediction_grpc
 from seldon_tpu.runtime import seldon_methods
@@ -36,7 +37,6 @@ from seldon_tpu.runtime.user_model import SeldonNotImplementedError
 
 logger = logging.getLogger(__name__)
 
-PROTO_CONTENT_TYPE = "application/x-protobuf"
 
 
 def _unit_name() -> str:
@@ -92,24 +92,10 @@ def build_rest_app(
     app["metrics"] = metrics
 
     async def _parse_request(request: web.Request, req_cls):
-        ctype = request.headers.get("Content-Type", "")
-        if ctype.startswith(PROTO_CONTENT_TYPE):
-            body = await request.read()
-            return req_cls.FromString(body), "proto"
-        if request.method == "GET":
-            raw = request.query.get("json")
-            if raw is None:
-                raise SeldonMicroserviceException("empty json parameter in request")
-            return payloads.dict_to_message(json.loads(raw), req_cls), "json"
-        if ctype.startswith("application/json"):
-            payload = await request.json()
-        else:
-            form = await request.post()
-            raw = form.get("json")
-            if raw is None:
-                raise SeldonMicroserviceException("no json payload in request")
-            payload = json.loads(raw)
-        return payloads.dict_to_message(payload, req_cls), "json"
+        try:
+            return await http.parse_message(request, req_cls)
+        except ValueError as e:
+            raise SeldonMicroserviceException(str(e))
 
     def _handler(method_name: str):
         fn, req_cls = _METHOD_TABLE[method_name]

@@ -198,17 +198,24 @@ class InferenceEngine:
     def generate_blocking(
         self, tokens: Sequence[int], params: Optional[SamplingParams] = None
     ) -> Dict[str, Any]:
-        """Submit and collect the full completion."""
+        """Submit and collect the full completion. Raises RuntimeError if the
+        engine failed the request (bad params, decode error)."""
         out = self.submit(tokens, params)
         toks: List[int] = []
         ttft_ms = None
+        error = None
         while True:
             item = out.get()
             if item is None:
                 break
+            if "error" in item:
+                error = item["error"]
+                continue
             toks.append(item["token"])
             if ttft_ms is None:
                 ttft_ms = item.get("ttft_ms")
+        if error is not None:
+            raise RuntimeError(f"generation failed: {error}")
         return {"token_ids": toks, "ttft_ms": ttft_ms}
 
     def start(self):
@@ -237,54 +244,63 @@ class InferenceEngine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 return
-            slot = self._free.pop()
-            req.slot = slot
-            Sb = self._bucket(len(req.tokens))
-            toks = np.full((1, Sb), self.cfg.pad_token_id, np.int32)
-            toks[0, : len(req.tokens)] = req.tokens
-            plen = jnp.asarray([len(req.tokens)], jnp.int32)
-            sp = req.params
-            # First token keyed by (seed, prompt position) — same seed +
-            # same prompt reproduces the completion regardless of traffic.
-            first, sub_k, sub_v = self._jit_prefill(
-                self.params,
-                jnp.asarray(toks),
-                plen,
-                jax.random.fold_in(jax.random.key(sp.seed), len(req.tokens)),
-                jnp.asarray([sp.temperature], jnp.float32),
-                jnp.asarray([sp.top_k], jnp.int32),
-                jnp.asarray([sp.top_p], jnp.float32),
-            )
-            self._cache = self._jit_insert(self._cache, sub_k, sub_v, slot)
-            first_tok = int(np.asarray(first)[0])
-            now = time.perf_counter()
-            req.first_token_at = now
-            ttft_ms = 1000.0 * (now - req.submitted_at)
-            with self.stats.lock:
-                self.stats.ttft_sum += ttft_ms / 1000.0
-                self.stats.ttft_count += 1
-                self.stats.tokens_out += 1
-            req.n_generated = 1
-            self._slots[slot] = req
-            req.out.put({"token": first_tok, "ttft_ms": ttft_ms})
-            if (
-                first_tok == self.cfg.eos_token_id
-                or req.params.max_new_tokens <= 1
-                or len(req.tokens) + 1 >= self.ecfg.max_seq_len
-            ):
-                self._finish(slot)
-                continue
-            # Arm the slot for decoding.
-            self._last_tok = self._last_tok.at[slot].set(first_tok)
-            self._pos = self._pos.at[slot].set(len(req.tokens))
-            self._active = self._active.at[slot].set(True)
-            self._active_host[slot] = True
-            self._temp = self._temp.at[slot].set(sp.temperature)
-            self._top_k = self._top_k.at[slot].set(sp.top_k)
-            self._top_p = self._top_p.at[slot].set(sp.top_p)
-            self._seeds = self._seeds.at[slot].set(
-                np.uint32(sp.seed & 0xFFFFFFFF)
-            )
+            try:
+                self._admit_one(req)
+            except Exception as e:  # bad request must not kill the loop
+                logger.exception("admission failed for request %d", req.rid)
+                if req.slot >= 0 and self._slots[req.slot] is None:
+                    self._free.append(req.slot)
+                req.out.put({"error": str(e)})
+                req.out.put(None)
+
+    def _admit_one(self, req: _Request) -> None:
+        slot = self._free.pop()
+        req.slot = slot
+        Sb = self._bucket(len(req.tokens))
+        toks = np.full((1, Sb), self.cfg.pad_token_id, np.int32)
+        toks[0, : len(req.tokens)] = req.tokens
+        plen = jnp.asarray([len(req.tokens)], jnp.int32)
+        sp = req.params
+        seed = int(sp.seed) & 0xFFFFFFFF  # clamp before jax.random.key
+        # First token keyed by (seed, prompt position) — same seed +
+        # same prompt reproduces the completion regardless of traffic.
+        first, sub_k, sub_v = self._jit_prefill(
+            self.params,
+            jnp.asarray(toks),
+            plen,
+            jax.random.fold_in(jax.random.key(seed), len(req.tokens)),
+            jnp.asarray([sp.temperature], jnp.float32),
+            jnp.asarray([sp.top_k], jnp.int32),
+            jnp.asarray([sp.top_p], jnp.float32),
+        )
+        self._cache = self._jit_insert(self._cache, sub_k, sub_v, slot)
+        first_tok = int(np.asarray(first)[0])
+        now = time.perf_counter()
+        req.first_token_at = now
+        ttft_ms = 1000.0 * (now - req.submitted_at)
+        with self.stats.lock:
+            self.stats.ttft_sum += ttft_ms / 1000.0
+            self.stats.ttft_count += 1
+            self.stats.tokens_out += 1
+        req.n_generated = 1
+        self._slots[slot] = req
+        req.out.put({"token": first_tok, "ttft_ms": ttft_ms})
+        if (
+            first_tok == self.cfg.eos_token_id
+            or req.params.max_new_tokens <= 1
+            or len(req.tokens) + 1 >= self.ecfg.max_seq_len
+        ):
+            self._finish(slot)
+            return
+        # Arm the slot for decoding.
+        self._last_tok = self._last_tok.at[slot].set(first_tok)
+        self._pos = self._pos.at[slot].set(len(req.tokens))
+        self._active = self._active.at[slot].set(True)
+        self._active_host[slot] = True
+        self._temp = self._temp.at[slot].set(sp.temperature)
+        self._top_k = self._top_k.at[slot].set(sp.top_k)
+        self._top_p = self._top_p.at[slot].set(sp.top_p)
+        self._seeds = self._seeds.at[slot].set(np.uint32(seed))
 
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]
@@ -305,31 +321,41 @@ class InferenceEngine:
                 if self._pending.empty():
                     time.sleep(self.ecfg.idle_sleep_s)
                 continue
-            self._cache, toks, self._pos = self._jit_decode(
-                self.params,
-                self._cache,
-                self._last_tok,
-                self._pos,
-                self._active,
-                self._seeds,
-                self._temp,
-                self._top_k,
-                self._top_p,
-            )
-            self._last_tok = toks
-            toks_host = np.asarray(toks)
-            pos_host = np.asarray(self._pos)
-            for slot, req in enumerate(self._slots):
-                if req is None or not self._active_host[slot]:
-                    continue
-                t = int(toks_host[slot])
-                req.out.put({"token": t})
-                req.n_generated += 1
-                with self.stats.lock:
-                    self.stats.tokens_out += 1
-                if (
-                    t == self.cfg.eos_token_id
-                    or req.n_generated >= req.params.max_new_tokens
-                    or int(pos_host[slot]) >= self.ecfg.max_seq_len - 1
-                ):
-                    self._finish(slot)
+            try:
+                self._decode_once()
+            except Exception as e:  # fail active requests, keep serving
+                logger.exception("decode iteration failed")
+                for slot, req in enumerate(self._slots):
+                    if req is not None:
+                        req.out.put({"error": str(e)})
+                        self._finish(slot)
+
+    def _decode_once(self) -> None:
+        self._cache, toks, self._pos = self._jit_decode(
+            self.params,
+            self._cache,
+            self._last_tok,
+            self._pos,
+            self._active,
+            self._seeds,
+            self._temp,
+            self._top_k,
+            self._top_p,
+        )
+        self._last_tok = toks
+        toks_host = np.asarray(toks)
+        pos_host = np.asarray(self._pos)
+        for slot, req in enumerate(self._slots):
+            if req is None or not self._active_host[slot]:
+                continue
+            t = int(toks_host[slot])
+            req.out.put({"token": t})
+            req.n_generated += 1
+            with self.stats.lock:
+                self.stats.tokens_out += 1
+            if (
+                t == self.cfg.eos_token_id
+                or req.n_generated >= req.params.max_new_tokens
+                or int(pos_host[slot]) >= self.ecfg.max_seq_len - 1
+            ):
+                self._finish(slot)

@@ -218,6 +218,8 @@ class JAXServer(SeldonComponent):
             item = out_q.get()
             if item is None:
                 break
+            if "error" in item:
+                raise RuntimeError(f"generation failed: {item['error']}")
             tok = item["token"]
             if tok == self.cfg.eos_token_id:
                 continue

@@ -237,3 +237,27 @@ def test_storage_relative_key():
     assert _relative_key("models/ab/x.bin", "models/a") is None
     assert _relative_key("models/a", "models/a") == "a"
     assert _relative_key("k", "") == "k"
+
+
+def test_engine_bad_request_fails_cleanly(engine):
+    """An admission failure must fail that request only (no wedged loop);
+    the engine keeps serving afterwards. Also: absurd seeds are clamped,
+    not fatal."""
+    real_prefill = engine._jit_prefill
+
+    def boom(*a, **k):
+        raise ValueError("injected prefill failure")
+
+    engine._jit_prefill = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            engine.generate_blocking(
+                [3, 4], SamplingParams(temperature=0.0, max_new_tokens=2)
+            )
+    finally:
+        engine._jit_prefill = real_prefill
+    # Engine still serves, including a seed far beyond uint32.
+    ok = engine.generate_blocking(
+        [3, 4], SamplingParams(temperature=1.0, max_new_tokens=2, seed=2**80)
+    )
+    assert len(ok["token_ids"]) >= 1

@@ -1,0 +1,193 @@
+"""Microservice CLI, persistence, SeldonClient, contract tester.
+
+Mirrors reference python/tests/test_microservice.py (spawns a real
+subprocess and hits it with the contract tester)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from seldon_tpu.client import SeldonClient
+from seldon_tpu.runtime.microservice import parse_parameters
+from seldon_tpu.runtime import persistence
+from seldon_tpu.runtime.tester import (
+    generate_batch,
+    run_contract_test,
+    validate_response,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODEL_APP = """
+import numpy as np
+
+class EchoScaler:
+    def __init__(self, factor=2.0):
+        self.factor = float(factor)
+
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * self.factor
+
+    def tags(self):
+        return {"m": "echo"}
+"""
+
+CONTRACT = {
+    "features": [
+        {"name": "f1", "dtype": "FLOAT", "ftype": "continuous", "range": [0, 1]},
+        {"name": "f2", "dtype": "FLOAT", "ftype": "continuous", "range": [0, 1]},
+    ],
+    "targets": [
+        {"name": "o1", "dtype": "FLOAT", "ftype": "continuous",
+         "range": [0, 3], "repeat": 2},
+    ],
+}
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_parse_parameters():
+    raw = json.dumps(
+        [
+            {"name": "a", "value": "3", "type": "INT"},
+            {"name": "b", "value": "0.5", "type": "FLOAT"},
+            {"name": "c", "value": "true", "type": "BOOL"},
+            {"name": "d", "value": "x", "type": "STRING"},
+        ]
+    )
+    assert parse_parameters(raw) == {"a": 3, "b": 0.5, "c": True, "d": "x"}
+
+
+@pytest.fixture(scope="module")
+def microservice(tmp_path_factory):
+    """Real subprocess running the CLI on a user model file."""
+    workdir = tmp_path_factory.mktemp("app")
+    (workdir / "EchoScaler.py").write_text(MODEL_APP)
+    http_port, grpc_port = _free_port(), _free_port()
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        PREDICTIVE_UNIT_PARAMETERS=json.dumps(
+            [{"name": "factor", "value": "2.0", "type": "FLOAT"}]
+        ),
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "seldon_tpu.runtime.microservice",
+            "EchoScaler", "--api-type", "REST,GRPC",
+            "--http-port", str(http_port), "--grpc-port", str(grpc_port),
+            "--host", "127.0.0.1",
+        ],
+        cwd=workdir,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    # Wait for readiness.
+    deadline = time.time() + 30
+    ready = False
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", http_port), 0.2):
+                ready = True
+                break
+        except OSError:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                raise RuntimeError(f"microservice died:\n{out}")
+            time.sleep(0.1)
+    assert ready, "microservice never came up"
+    yield http_port, grpc_port
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_cli_rest_predict(microservice):
+    http_port, _ = microservice
+    client = SeldonClient(host="127.0.0.1", port=http_port, transport="rest")
+    r = client.microservice(data=np.array([[1.0, 2.0]]), method="predict")
+    assert r.success, r.error
+    np.testing.assert_allclose(r.data, [[2.0, 4.0]])
+
+
+def test_cli_grpc_predict(microservice):
+    _, grpc_port = microservice
+    client = SeldonClient(
+        host="127.0.0.1", grpc_port=grpc_port, transport="grpc"
+    )
+    r = client.microservice(data=np.array([[3.0, 4.0]]), method="predict")
+    assert r.success, r.error
+    np.testing.assert_allclose(r.data, [[6.0, 8.0]])
+    client.close()
+
+
+def test_cli_rest_proto_fast_path(microservice):
+    http_port, _ = microservice
+    client = SeldonClient(
+        host="127.0.0.1", port=http_port, transport="rest-proto"
+    )
+    r = client.microservice(data=np.array([[5.0, 6.0]], dtype=np.float32))
+    assert r.success, r.error
+    out = r.data
+    assert out.dtype == np.float32  # dense fast path preserves dtype
+    np.testing.assert_allclose(out, [[10.0, 12.0]])
+
+
+def test_contract_tester_against_cli(microservice, tmp_path):
+    http_port, _ = microservice
+    cpath = tmp_path / "contract.json"
+    cpath.write_text(json.dumps(CONTRACT))
+    result = run_contract_test(
+        str(cpath), host="127.0.0.1", port=http_port, transport="rest",
+        n_requests=5, batch_size=3,
+    )
+    assert result["ok"], result["failures"]
+
+
+def test_contract_generator_shapes():
+    X, names = generate_batch(CONTRACT, 4)
+    assert X.shape == (4, 2)
+    assert names == ["f1", "f2"]
+    problems = validate_response(CONTRACT, X * 2.0)
+    assert problems == []
+    problems = validate_response(CONTRACT, X * 100.0)
+    assert problems  # out of target range
+
+
+class _Bandit:
+    def __init__(self):
+        self.counts = [0, 0]
+
+
+def test_persistence_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(persistence, "_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("PREDICTIVE_UNIT_ID", "u1")
+    obj = _Bandit()
+    obj.counts = [5, 9]
+    persistence.persist(obj)
+    restored = persistence.restore(_Bandit())
+    assert restored is not None
+    assert restored.counts == [5, 9]
+
+
+def test_persistence_none_when_empty(tmp_path, monkeypatch):
+    monkeypatch.setattr(persistence, "_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("PREDICTIVE_UNIT_ID", "nothing-here")
+    assert persistence.restore(_Bandit()) is None
