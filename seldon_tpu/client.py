@@ -145,20 +145,51 @@ class SeldonClient:
             return self._rest("/api/v0.1/feedback", fb, pb.SeldonMessage)
         return self._grpc_call("Seldon", "SendFeedback", fb, pb.SeldonMessage)
 
+    _MICROSERVICE_METHODS = {
+        "predict": ("Model", "Predict"),
+        "transform_input": ("Generic", "TransformInput"),
+        "transform_output": ("Generic", "TransformOutput"),
+        "route": ("Router", "Route"),
+        "aggregate": ("Combiner", "Aggregate"),
+        "send_feedback": ("Generic", "SendFeedback"),
+    }
+
     def microservice(self, data=None, method="predict", names=None,
-                     payload_kind="dense", msg=None) -> ClientResponse:
-        """Call a bare unit microservice (reference `microservice` gateway)."""
-        request = self._build_request(data, payload_kind, names, msg)
+                     payload_kind="dense", msg=None,
+                     msgs=None) -> ClientResponse:
+        """Call a bare unit microservice (reference `microservice` gateway).
+
+        `aggregate` takes `msgs` (list of SeldonMessage, or list of arrays);
+        `send_feedback` takes `msg` as a pb.Feedback."""
+        if method not in self._MICROSERVICE_METHODS:
+            return ClientResponse(
+                False,
+                error=f"unknown method {method!r}; expected one of "
+                f"{sorted(self._MICROSERVICE_METHODS)}",
+            )
+        if method == "aggregate":
+            request = pb.SeldonMessageList()
+            for m in msgs or []:
+                if isinstance(m, pb.SeldonMessage):
+                    request.seldonMessages.append(m)
+                else:
+                    request.seldonMessages.append(
+                        payloads.build_message(np.asarray(m), kind=payload_kind)
+                    )
+        elif method == "send_feedback":
+            if not isinstance(msg, pb.Feedback):
+                return ClientResponse(
+                    False, error="send_feedback requires msg=pb.Feedback"
+                )
+            request = msg
+        else:
+            request = self._build_request(data, payload_kind, names, msg)
         if self.transport.startswith("rest"):
             path = "/" + method.replace("_", "-")
             return self._rest(path, request, pb.SeldonMessage)
-        service_method = {
-            "predict": ("Model", "Predict"),
-            "transform_input": ("Generic", "TransformInput"),
-            "transform_output": ("Generic", "TransformOutput"),
-            "route": ("Router", "Route"),
-        }[method]
-        return self._grpc_call(*service_method, request, pb.SeldonMessage)
+        return self._grpc_call(
+            *self._MICROSERVICE_METHODS[method], request, pb.SeldonMessage
+        )
 
     def generate(self, prompt: str = "", prompt_token_ids=None,
                  max_new_tokens: int = 16, temperature: float = 0.7,

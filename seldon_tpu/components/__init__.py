@@ -1,0 +1,18 @@
+"""Graph components: bandit routers and outlier detectors.
+
+Reference: /root/reference/components/ (SURVEY.md §2.7) — ε-greedy and
+Thompson-sampling multi-armed-bandit routers whose state survives restarts
+via the persistence layer, and outlier detectors usable either as MODEL
+(predict returns scores) or TRANSFORMER (transform_input tags outliers
+into meta.tags and scores into custom metrics).
+"""
+
+from seldon_tpu.components.routers import EpsilonGreedy, ThompsonSampling
+from seldon_tpu.components.outliers import MahalanobisDetector, ZScoreDetector
+
+__all__ = [
+    "EpsilonGreedy",
+    "ThompsonSampling",
+    "MahalanobisDetector",
+    "ZScoreDetector",
+]

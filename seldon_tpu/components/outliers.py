@@ -1,0 +1,150 @@
+"""Outlier detectors — usable as MODEL (predict -> scores) or TRANSFORMER
+(transform_input passes data through, tagging outliers into meta.tags and
+scores into custom metrics).
+
+Reference: components/outlier-detection/ (SURVEY.md §2.7) — the Mahalanobis
+detector (CoreMahalanobis.py:7-191, online mean/covariance) is the flagship;
+the keras VAE/Seq2Seq detectors are replaced by numpy/JAX-native math (no
+keras in this image). State is picklable for the persistence layer."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class _TagMetricsMixin:
+    """Shared MODEL/TRANSFORMER duality: predict scores, transform tags."""
+
+    threshold: float
+    _last_scores: Optional[np.ndarray]
+
+    def transform_input(self, X: np.ndarray, names: Iterable[str],
+                        meta: Optional[Dict] = None):
+        self.predict(X, names, meta)  # updates _last_scores / state
+        return X  # pass-through; verdict rides on tags/metrics
+
+    def tags(self) -> Dict:
+        s = self._last_scores
+        if s is None:
+            return {}
+        return {
+            "outlier": bool(np.any(s > self.threshold)),
+            "outlier_count": int(np.sum(s > self.threshold)),
+        }
+
+    def metrics(self) -> List[Dict]:
+        s = self._last_scores
+        if s is None:
+            return []
+        return [
+            {"type": "GAUGE", "key": "outlier_score_max",
+             "value": float(np.max(s))},
+            {"type": "GAUGE", "key": "outlier_score_mean",
+             "value": float(np.mean(s))},
+        ]
+
+
+class MahalanobisDetector(_TagMetricsMixin):
+    """Online Mahalanobis distance: running mean + covariance (Welford-style
+    batch updates), score = sqrt((x-mu)^T Sigma^-1 (x-mu)).
+
+    `start_clip` samples must arrive before scores are reported (the
+    reference clips early unstable estimates the same way)."""
+
+    def __init__(self, threshold: float = 3.0, start_clip: int = 20,
+                 reg_eps: float = 1e-6):
+        self.threshold = float(threshold)
+        self.start_clip = int(start_clip)
+        self.reg_eps = float(reg_eps)
+        self.n = 0
+        self.mean: Optional[np.ndarray] = None
+        self.cov_sum: Optional[np.ndarray] = None  # sum of outer deviations
+        self._last_scores: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    def _update(self, X: np.ndarray) -> None:
+        for x in X:
+            self.n += 1
+            if self.mean is None:
+                self.mean = x.astype(np.float64).copy()
+                self.cov_sum = np.zeros((x.size, x.size))
+                continue
+            delta = x - self.mean
+            self.mean += delta / self.n
+            self.cov_sum += np.outer(delta, x - self.mean)
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None) -> np.ndarray:
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        with self._lock:
+            if self.n >= max(self.start_clip, 2):
+                cov = self.cov_sum / (self.n - 1)
+                cov = cov + self.reg_eps * np.eye(cov.shape[0])
+                inv = np.linalg.pinv(cov)
+                d = X - self.mean
+                scores = np.sqrt(np.maximum(
+                    np.einsum("bi,ij,bj->b", d, inv, d), 0.0
+                ))
+            else:
+                scores = np.zeros(X.shape[0])
+            self._update(X)
+            self._last_scores = scores
+        return scores
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+
+class ZScoreDetector(_TagMetricsMixin):
+    """Per-feature running z-score; score = max |z| over features. The
+    lightweight stand-in for the reference's IsolationForest (sklearn is
+    not in this image)."""
+
+    def __init__(self, threshold: float = 4.0, start_clip: int = 10):
+        self.threshold = float(threshold)
+        self.start_clip = int(start_clip)
+        self.n = 0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+        self._last_scores: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None) -> np.ndarray:
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        with self._lock:
+            if self.n >= self.start_clip and self.m2 is not None:
+                var = self.m2 / max(self.n - 1, 1)
+                std = np.sqrt(np.maximum(var, 1e-12))
+                scores = np.max(np.abs((X - self.mean) / std), axis=1)
+            else:
+                scores = np.zeros(X.shape[0])
+            for x in X:
+                self.n += 1
+                if self.mean is None:
+                    self.mean = x.copy()
+                    self.m2 = np.zeros_like(x)
+                else:
+                    delta = x - self.mean
+                    self.mean += delta / self.n
+                    self.m2 += delta * (x - self.mean)
+            self._last_scores = scores
+        return scores
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()

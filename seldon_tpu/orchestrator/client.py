@@ -125,9 +125,12 @@ class InternalClient:
                 if isinstance(e, grpc.aio.AioRpcError):
                     retryable = e.code() == grpc.StatusCode.UNAVAILABLE
                 else:
+                    import aiohttp
+
                     retryable = isinstance(
                         e, (ConnectionRefusedError, ConnectionResetError,
-                            ConnectionAbortedError, BrokenPipeError)
+                            ConnectionAbortedError, BrokenPipeError,
+                            aiohttp.ClientConnectorError)
                     )
                 if not retryable:
                     break

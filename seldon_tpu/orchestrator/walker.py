@@ -136,7 +136,9 @@ class PredictorEngine:
         if branch == -1:
             selected = unit.children
         else:
-            if branch >= len(unit.children):
+            if not 0 <= branch < len(unit.children):
+                # -1 is the only legal sentinel; other negatives would hit
+                # Python negative indexing and silently pick a wrong child.
                 raise UnitCallError(
                     unit.name, "route",
                     f"branch {branch} out of range ({len(unit.children)} children)",

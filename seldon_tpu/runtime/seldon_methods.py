@@ -149,12 +149,24 @@ def send_feedback(user_obj: Any, feedback: pb.Feedback, unit_name: str = "") -> 
     X, _, _, _ = payloads.extract_request_parts(req)
     names = list(req.data.names) if req.WhichOneof("data_oneof") == "data" else []
     truth, _, _, _ = payloads.extract_request_parts(feedback.truth)
+    # The engine stamps routing decisions into the RESPONSE meta
+    # (walker._RequestCtx.stamp); the request meta is checked as fallback.
+    import os
+
+    unit_name = unit_name or os.environ.get("PREDICTIVE_UNIT_ID", "")
     routing = None
-    if unit_name and unit_name in req.meta.routing:
-        routing = req.meta.routing[unit_name]
-    elif req.meta.routing:
-        # Single-router graphs: use the only routing entry.
-        routing = next(iter(req.meta.routing.values()))
+    metas = (feedback.response.meta, req.meta)
+    # Exact unit-name match in either meta wins before any fallback.
+    for meta in metas:
+        if unit_name and unit_name in meta.routing:
+            routing = meta.routing[unit_name]
+            break
+    if routing is None:
+        for meta in metas:
+            if meta.routing:
+                # Single-router graphs: use the only routing entry.
+                routing = next(iter(meta.routing.values()))
+                break
     try:
         out = um.client_send_feedback(user_obj, X, names, feedback.reward, truth, routing=routing)
     except um.SeldonNotImplementedError:

@@ -56,7 +56,11 @@ def generate_batch(contract: Dict, batch_size: int,
             arr = np.stack(
                 [np.ravel(_gen_feature(spec, rng)) for _ in range(batch_size)]
             )
-            cols.append(arr.astype(np.float64))
+            # STRING categoricals stay object dtype (serialized as the
+            # ndarray wire form, matching the reference tester).
+            if arr.dtype.kind in "fiub":
+                arr = arr.astype(np.float64)
+            cols.append(arr)
             base = spec["name"]
             width = arr.shape[1]
             names.extend(
@@ -119,8 +123,9 @@ def run_contract_test(
     failures = []
     for i in range(n_requests):
         X, names = generate_batch(contract, batch_size, rng)
+        kind = payload_kind if X.dtype.kind in "fiub" else "ndarray"
         r = client.microservice(
-            data=X, method=method, names=names, payload_kind=payload_kind
+            data=X, method=method, names=names, payload_kind=kind
         )
         if not r.success:
             failures.append(f"request {i}: {r.error}")

@@ -248,8 +248,16 @@ class InferenceEngine:
                 self._admit_one(req)
             except Exception as e:  # bad request must not kill the loop
                 logger.exception("admission failed for request %d", req.rid)
-                if req.slot >= 0 and self._slots[req.slot] is None:
-                    self._free.append(req.slot)
+                slot = req.slot
+                if slot >= 0:
+                    # Reclaim the slot whether or not registration got as
+                    # far as self._slots[slot] = req.
+                    if self._slots[slot] is req:
+                        self._slots[slot] = None
+                        self._active = self._active.at[slot].set(False)
+                        self._active_host[slot] = False
+                    if slot not in self._free:
+                        self._free.append(slot)
                 req.out.put({"error": str(e)})
                 req.out.put(None)
 

@@ -1,0 +1,137 @@
+"""Bandit routers + outlier detectors (reference components/, SURVEY §2.7)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from seldon_tpu.components import (
+    EpsilonGreedy,
+    MahalanobisDetector,
+    ThompsonSampling,
+    ZScoreDetector,
+)
+
+
+def test_epsilon_greedy_learns_best_branch():
+    r = EpsilonGreedy(n_branches=3, epsilon=0.1, seed=0)
+    # Branch 2 pays best.
+    rng = np.random.default_rng(0)
+    for _ in range(300):
+        branch = r.route(np.array([[1.0]]), [])
+        reward = {0: 0.1, 1: 0.4, 2: 0.9}[branch] + rng.normal(0, 0.01)
+        r.send_feedback(np.array([[1.0]]), [], reward, None, routing=branch)
+    assert r.best_branch == 2
+    choices = [r.route(np.array([[1.0]]), []) for _ in range(100)]
+    assert np.mean(np.array(choices) == 2) > 0.8  # mostly exploits
+
+
+def test_epsilon_greedy_explores():
+    r = EpsilonGreedy(n_branches=2, epsilon=1.0, seed=0)  # pure exploration
+    choices = {r.route(np.array([[1.0]]), []) for _ in range(50)}
+    assert choices == {0, 1}
+
+
+def test_thompson_sampling_converges():
+    r = ThompsonSampling(n_branches=2, seed=0)
+    rng = np.random.default_rng(1)
+    for _ in range(400):
+        b = r.route(np.array([[1.0]]), [])
+        reward = float(rng.random() < (0.8 if b == 1 else 0.2))
+        r.send_feedback(np.array([[1.0]]), [], reward, None, routing=b)
+    choices = [r.route(np.array([[1.0]]), []) for _ in range(100)]
+    assert np.mean(np.array(choices) == 1) > 0.8
+
+
+def test_routers_pickle_roundtrip():
+    r = EpsilonGreedy(n_branches=2, seed=0)
+    r.send_feedback(None, [], 1.0, None, routing=1)
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r2.branch_count == r.branch_count
+    assert r2.route(np.array([[1.0]]), []) in (0, 1)
+
+    t = ThompsonSampling(n_branches=2, seed=0)
+    t.send_feedback(None, [], 1.0, None, routing=0)
+    t2 = pickle.loads(pickle.dumps(t))
+    assert t2.successes == t.successes
+
+
+def test_router_ignores_invalid_routing():
+    r = EpsilonGreedy(n_branches=2, seed=0)
+    r.send_feedback(None, [], 5.0, None, routing=None)
+    r.send_feedback(None, [], 5.0, None, routing=7)
+    assert r.branch_count == [0, 0]
+
+
+def test_mahalanobis_flags_outliers():
+    det = MahalanobisDetector(threshold=3.0, start_clip=20)
+    rng = np.random.default_rng(0)
+    inliers = rng.normal(0, 1, (200, 4))
+    det.predict(inliers, [])
+    scores_in = det.predict(rng.normal(0, 1, (20, 4)), [])
+    scores_out = det.predict(np.full((5, 4), 25.0), [])
+    assert scores_out.min() > scores_in.max()
+    assert det.tags()["outlier"] is True
+    assert det.tags()["outlier_count"] == 5
+    m = {d["key"]: d["value"] for d in det.metrics()}
+    assert m["outlier_score_max"] > 3.0
+
+
+def test_mahalanobis_warmup_silent():
+    det = MahalanobisDetector(start_clip=50)
+    scores = det.predict(np.random.default_rng(0).normal(0, 1, (10, 3)), [])
+    np.testing.assert_array_equal(scores, 0.0)
+    assert det.tags() == {"outlier": False, "outlier_count": 0}
+
+
+def test_zscore_detector():
+    det = ZScoreDetector(threshold=4.0, start_clip=10)
+    rng = np.random.default_rng(0)
+    det.predict(rng.normal(0, 1, (100, 3)), [])
+    out = det.predict(np.array([[50.0, 0.0, 0.0]]), [])
+    assert out[0] > 4.0
+    assert det.tags()["outlier"] is True
+
+
+def test_detector_transform_mode_passthrough():
+    det = ZScoreDetector(start_clip=1)
+    X = np.array([[1.0, 2.0]])
+    out = det.transform_input(X, [])
+    np.testing.assert_array_equal(out, X)
+
+
+def test_detector_pickle_roundtrip():
+    det = MahalanobisDetector(start_clip=5)
+    det.predict(np.random.default_rng(0).normal(0, 1, (30, 3)), [])
+    det2 = pickle.loads(pickle.dumps(det))
+    assert det2.n == det.n
+    s = det2.predict(np.full((1, 3), 10.0), [])
+    assert s[0] > 0
+
+
+def test_client_aggregate_and_unknown_method():
+    """SeldonClient returns error responses, never raw KeyError."""
+    from seldon_tpu.client import SeldonClient
+
+    c = SeldonClient(transport="grpc", grpc_port=1)  # nothing listening
+    r = c.microservice(method="nope")
+    assert not r.success and "unknown method" in r.error
+    r = c.microservice(method="send_feedback", msg=None)
+    assert not r.success and "Feedback" in r.error
+    c.close()
+
+
+def test_tester_string_categorical_batch():
+    from seldon_tpu.runtime.tester import generate_batch
+
+    contract = {
+        "features": [
+            {"name": "s", "dtype": "STRING", "ftype": "categorical",
+             "values": ["a", "b"]},
+            {"name": "x", "dtype": "FLOAT", "range": [0, 1]},
+        ]
+    }
+    X, names = generate_batch(contract, 3)
+    assert X.shape == (3, 2)
+    assert X.dtype == object
+    assert set(np.unique(X[:, 0])) <= {"a", "b"}
