@@ -168,8 +168,16 @@ class SeldonClient:
                 f"{sorted(self._MICROSERVICE_METHODS)}",
             )
         if method == "aggregate":
+            if msgs is None and data is not None:
+                # predict-style convenience: data = list of per-child arrays.
+                msgs = list(data)
+            if not msgs:
+                return ClientResponse(
+                    False,
+                    error="aggregate requires msgs=[SeldonMessage|array, ...]",
+                )
             request = pb.SeldonMessageList()
-            for m in msgs or []:
+            for m in msgs:
                 if isinstance(m, pb.SeldonMessage):
                     request.seldonMessages.append(m)
                 else:

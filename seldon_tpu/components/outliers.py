@@ -16,10 +16,29 @@ import numpy as np
 
 
 class _TagMetricsMixin:
-    """Shared MODEL/TRANSFORMER duality: predict scores, transform tags."""
+    """Shared MODEL/TRANSFORMER duality: predict scores, transform tags.
+
+    The score->tags handoff uses THREAD-LOCAL storage: the unit server runs
+    requests on a thread pool, and predict()+tags() for one request execute
+    on the same worker thread — instance-global state would let concurrent
+    requests read each other's verdicts."""
 
     threshold: float
-    _last_scores: Optional[np.ndarray]
+
+    @property
+    def _tls(self):
+        tls = getattr(self, "_tls_obj", None)
+        if tls is None:
+            tls = self._tls_obj = threading.local()
+        return tls
+
+    @property
+    def _last_scores(self) -> Optional[np.ndarray]:
+        return getattr(self._tls, "scores", None)
+
+    @_last_scores.setter
+    def _last_scores(self, value) -> None:
+        self._tls.scores = value
 
     def transform_input(self, X: np.ndarray, names: Iterable[str],
                         meta: Optional[Dict] = None):
@@ -62,7 +81,6 @@ class MahalanobisDetector(_TagMetricsMixin):
         self.n = 0
         self.mean: Optional[np.ndarray] = None
         self.cov_sum: Optional[np.ndarray] = None  # sum of outer deviations
-        self._last_scores: Optional[np.ndarray] = None
         self._lock = threading.Lock()
 
     def _update(self, X: np.ndarray) -> None:
@@ -97,6 +115,7 @@ class MahalanobisDetector(_TagMetricsMixin):
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_lock", None)
+        d.pop("_tls_obj", None)
         return d
 
     def __setstate__(self, d):
@@ -115,7 +134,6 @@ class ZScoreDetector(_TagMetricsMixin):
         self.n = 0
         self.mean: Optional[np.ndarray] = None
         self.m2: Optional[np.ndarray] = None
-        self._last_scores: Optional[np.ndarray] = None
         self._lock = threading.Lock()
 
     def predict(self, X: np.ndarray, names: Iterable[str],
@@ -143,6 +161,7 @@ class ZScoreDetector(_TagMetricsMixin):
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_lock", None)
+        d.pop("_tls_obj", None)
         return d
 
     def __setstate__(self, d):

@@ -30,6 +30,10 @@ class ModelConfig:
     n_experts_per_token: int = 2
     eos_token_id: int = 128001
     pad_token_id: int = 0
+    # "xla" = einsum attention (GSPMD-shardable, default); "flash" = pallas
+    # blockwise kernel on the full-sequence path (single-device / tp=1 —
+    # pallas ops don't auto-partition under GSPMD).
+    attn_impl: str = "xla"
 
     @property
     def head_dim(self) -> int:

@@ -195,7 +195,28 @@ def _block(
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
-    if kv is not None:
+    use_flash = cfg.attn_impl == "flash" and S > 1 and kv is None
+
+    if use_flash:
+        # Full-sequence causal path through the pallas flash kernel
+        # (ops/flash_attention.py). GQA: expand kv heads to q heads.
+        from seldon_tpu.ops.flash_attention import flash_attention
+
+        G = cfg.q_per_kv
+        k_exp = jnp.repeat(k, G, axis=2)  # [B,S,H,Dh]
+        v_exp = jnp.repeat(v, G, axis=2)
+
+        def fold(t):
+            return t.transpose(0, 2, 1, 3).reshape(B * cfg.n_heads, S, Dh)
+
+        out = flash_attention(fold(q), fold(k_exp), fold(v_exp), causal=True)
+        attn = (
+            out.reshape(B, cfg.n_heads, S, Dh)
+            .transpose(0, 2, 1, 3)
+            .reshape(B, S, cfg.n_heads * Dh)
+        )
+        new_kv = None
+    elif kv is not None:
         ck, cv = kv
         if S == ck.shape[1]:
             # Prefill covering the whole cache window: plain slot write.

@@ -1,0 +1,11 @@
+"""Hand-written TPU kernels (pallas) with XLA fallbacks.
+
+The reference has no kernel layer at all (CPU serving only). Here the hot
+ops get pallas implementations tuned to the TPU memory hierarchy
+(HBM->VMEM->MXU, /opt/skills/guides/pallas_guide.md), each with a pure-jnp
+fallback so the same code runs on the CPU test mesh.
+"""
+
+from seldon_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]
