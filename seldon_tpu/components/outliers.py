@@ -27,10 +27,9 @@ class _TagMetricsMixin:
 
     @property
     def _tls(self):
-        tls = getattr(self, "_tls_obj", None)
-        if tls is None:
-            tls = self._tls_obj = threading.local()
-        return tls
+        # _tls_obj is created eagerly in __init__/__setstate__ — lazy
+        # creation here would race under the server thread pool.
+        return self._tls_obj
 
     @property
     def _last_scores(self) -> Optional[np.ndarray]:
@@ -82,6 +81,7 @@ class MahalanobisDetector(_TagMetricsMixin):
         self.mean: Optional[np.ndarray] = None
         self.cov_sum: Optional[np.ndarray] = None  # sum of outer deviations
         self._lock = threading.Lock()
+        self._tls_obj = threading.local()
 
     def _update(self, X: np.ndarray) -> None:
         for x in X:
@@ -121,6 +121,7 @@ class MahalanobisDetector(_TagMetricsMixin):
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._lock = threading.Lock()
+        self._tls_obj = threading.local()
 
 
 class ZScoreDetector(_TagMetricsMixin):
@@ -135,6 +136,7 @@ class ZScoreDetector(_TagMetricsMixin):
         self.mean: Optional[np.ndarray] = None
         self.m2: Optional[np.ndarray] = None
         self._lock = threading.Lock()
+        self._tls_obj = threading.local()
 
     def predict(self, X: np.ndarray, names: Iterable[str],
                 meta: Optional[Dict] = None) -> np.ndarray:
@@ -167,3 +169,4 @@ class ZScoreDetector(_TagMetricsMixin):
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._lock = threading.Lock()
+        self._tls_obj = threading.local()

@@ -46,6 +46,9 @@ class ModelConfig:
     def validate(self) -> "ModelConfig":
         assert self.d_model % self.n_heads == 0, "d_model must divide by n_heads"
         assert self.n_heads % self.n_kv_heads == 0, "n_heads must divide by n_kv_heads"
+        assert self.attn_impl in ("xla", "flash"), (
+            f"unknown attn_impl {self.attn_impl!r}"
+        )
         if self.n_experts:
             assert self.n_experts_per_token <= self.n_experts
         return self

@@ -199,17 +199,16 @@ def _block(
 
     if use_flash:
         # Full-sequence causal path through the pallas flash kernel
-        # (ops/flash_attention.py). GQA: expand kv heads to q heads.
+        # (ops/flash_attention.py). GQA is native in the kernel: kv stays
+        # at Hkv heads and the q-head grid maps onto shared kv rows.
         from seldon_tpu.ops.flash_attention import flash_attention
 
-        G = cfg.q_per_kv
-        k_exp = jnp.repeat(k, G, axis=2)  # [B,S,H,Dh]
-        v_exp = jnp.repeat(v, G, axis=2)
-
         def fold(t):
-            return t.transpose(0, 2, 1, 3).reshape(B * cfg.n_heads, S, Dh)
+            n = t.shape[2]
+            return t.transpose(0, 2, 1, 3).reshape(B * n, S, Dh)
 
-        out = flash_attention(fold(q), fold(k_exp), fold(v_exp), causal=True)
+        out = flash_attention(fold(q), fold(k), fold(v), causal=True,
+                              q_per_kv=cfg.q_per_kv)
         attn = (
             out.reshape(B, cfg.n_heads, S, Dh)
             .transpose(0, 2, 1, 3)

@@ -1,0 +1,40 @@
+"""Control plane: SeldonDeployment -> k8s manifests, TPU-aware.
+
+Reference: the Go operator (/root/reference/operator/, SURVEY.md §2.2) —
+CRD types + naming, mutating/validating webhooks, reconciler emitting
+Deployments/Services/HPAs/Istio resources, engine + prepackaged-server +
+model-initializer injection.
+
+This build (no Go toolchain in the image) implements the same control
+logic in Python: `kubectl apply` manifests come out of `reconciler.py`
+as plain dicts (serializable to YAML), the defaulting/validation webhooks
+are pure functions over the CR, and reconcile semantics (incl. the
+zero-downtime stale-generation GC ordering) run against a pluggable
+cluster-state store so they are fully testable without a cluster.
+
+TPU-native extensions the reference never had: pods request
+`google.com/tpu` with `cloud.google.com/gke-tpu-topology` /
+`gke-tpu-accelerator` node selectors; multi-host slices get a headless
+service + stable ordinals (StatefulSet) and slice-aware readiness.
+"""
+
+from seldon_tpu.operator.types import (
+    SeldonDeployment,
+    DeploymentStatus,
+    machine_name,
+)
+from seldon_tpu.operator.webhook import (
+    default_deployment,
+    validate_deployment,
+)
+from seldon_tpu.operator.reconciler import Reconciler, InMemoryStore
+
+__all__ = [
+    "SeldonDeployment",
+    "DeploymentStatus",
+    "machine_name",
+    "default_deployment",
+    "validate_deployment",
+    "Reconciler",
+    "InMemoryStore",
+]

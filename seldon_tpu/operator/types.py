@@ -1,0 +1,173 @@
+"""SeldonDeployment CR types + k8s naming helpers.
+
+Reference: operator/api/v1alpha2/seldondeployment_types.go:29-47 (env
+consts), :75-133 (naming, md5 + 63-char truncation), :204-352 (types).
+The CR JSON shape matches the reference CRD so existing SeldonDeployment
+manifests parse unchanged; `tpu` fields are additive."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Dict, List, Optional
+
+from seldon_tpu.orchestrator.spec import PredictorSpec, PredictiveUnit
+
+# Env vars injected into unit containers (reference seldondeployment_types.go:29-47).
+ENV_PREDICTIVE_UNIT_SERVICE_PORT = "PREDICTIVE_UNIT_SERVICE_PORT"
+ENV_PREDICTIVE_UNIT_PARAMETERS = "PREDICTIVE_UNIT_PARAMETERS"
+ENV_PREDICTIVE_UNIT_ID = "PREDICTIVE_UNIT_ID"
+ENV_PREDICTOR_ID = "PREDICTOR_ID"
+ENV_SELDON_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
+ENV_ENGINE_PREDICTOR = "ENGINE_PREDICTOR"
+
+# Annotations (reference :43-47 + ambassador.go:10-22).
+ANNOTATION_SEPARATE_ENGINE = "seldon.io/engine-separate-pod"
+ANNOTATION_HEADLESS_SVC = "seldon.io/headless-svc"
+ANNOTATION_REST_READ_TIMEOUT = "seldon.io/rest-read-timeout"
+ANNOTATION_GRPC_MAX_MSG = "seldon.io/grpc-max-message-size"
+# TPU-native additions.
+ANNOTATION_TPU_TOPOLOGY = "seldon.io/tpu-topology"
+ANNOTATION_TPU_ACCELERATOR = "seldon.io/tpu-accelerator"
+
+DEFAULT_ENGINE_IMAGE = "seldon-tpu/engine:0.1.0"
+DEFAULT_SERVER_IMAGE = "seldon-tpu/microservice:0.1.0"
+FIRST_UNIT_PORT = 9000
+ENGINE_HTTP_PORT = 8000
+ENGINE_GRPC_PORT = 5001
+ENGINE_ADMIN_PORT = 8082
+
+
+@dataclasses.dataclass
+class TPUSpec:
+    """TPU placement for a predictor (green-field vs reference)."""
+
+    chips: int = 0  # google.com/tpu resource request per pod
+    topology: str = ""  # e.g. "2x4" -> cloud.google.com/gke-tpu-topology
+    accelerator: str = ""  # e.g. "tpu-v5-lite-podslice"
+    hosts: int = 1  # multi-host slice size (pods per replica)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "TPUSpec":
+        return TPUSpec(
+            chips=int(d.get("chips", 0)),
+            topology=d.get("topology", ""),
+            accelerator=d.get("accelerator", ""),
+            hosts=int(d.get("hosts", 1)),
+        )
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class PredictorExt:
+    """PredictorSpec plus operator-level fields the orchestrator spec
+    doesn't carry (componentSpecs images, tpu)."""
+
+    spec: PredictorSpec
+    tpu: TPUSpec = dataclasses.field(default_factory=TPUSpec)
+    component_images: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # unit name -> container resources overrides
+    resources: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "PredictorExt":
+        return PredictorExt(
+            spec=PredictorSpec.from_dict(d),
+            tpu=TPUSpec.from_dict(d.get("tpu", {})),
+            component_images=dict(d.get("componentImages", {})),
+            resources=dict(d.get("resources", {})),
+        )
+
+    def to_dict(self) -> Dict:
+        out = self.spec.to_dict()
+        if self.tpu.chips:
+            out["tpu"] = self.tpu.to_dict()
+        if self.component_images:
+            out["componentImages"] = self.component_images
+        return out
+
+
+@dataclasses.dataclass
+class DeploymentStatus:
+    state: str = "Creating"  # Creating | Available | Failed
+    description: str = ""
+    deployment_status: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    service_status: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SeldonDeployment:
+    name: str
+    namespace: str = "default"
+    predictors: List[PredictorExt] = dataclasses.field(default_factory=list)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    generation: int = 1
+    oauth_key: str = ""
+    status: DeploymentStatus = dataclasses.field(default_factory=DeploymentStatus)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "SeldonDeployment":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        return SeldonDeployment(
+            name=meta.get("name", spec.get("name", "seldon")),
+            namespace=meta.get("namespace", "default"),
+            predictors=[
+                PredictorExt.from_dict(p) for p in spec.get("predictors", [])
+            ],
+            annotations=dict(meta.get("annotations", {})),
+            labels=dict(meta.get("labels", {})),
+            generation=int(meta.get("generation", 1)),
+        )
+
+    def to_dict(self) -> Dict:
+        return {
+            "apiVersion": "machinelearning.seldon.io/v1alpha3",
+            "kind": "SeldonDeployment",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "annotations": self.annotations,
+                "labels": self.labels,
+                "generation": self.generation,
+            },
+            "spec": {
+                "name": self.name,
+                "predictors": [p.to_dict() for p in self.predictors],
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# Naming (reference seldondeployment_types.go:75-133)
+# ---------------------------------------------------------------------------
+
+
+def _hash_suffix(s: str) -> str:
+    return hashlib.md5(s.encode()).hexdigest()[:8]
+
+
+def machine_name(*parts: str, limit: int = 63) -> str:
+    """Deterministic k8s-safe resource name: joined parts, md5-suffixed when
+    truncation is needed (mirrors GetSeldonDeploymentName semantics)."""
+    name = "-".join(p for p in parts if p).lower().replace("_", "-")
+    if len(name) <= limit:
+        return name
+    return name[: limit - 9] + "-" + _hash_suffix(name)
+
+
+def predictor_deployment_name(sdep: SeldonDeployment, pred: PredictorExt,
+                              component_idx: int = 0) -> str:
+    return machine_name(sdep.name, pred.spec.name, str(component_idx))
+
+
+def predictor_service_name(sdep: SeldonDeployment, pred: PredictorExt) -> str:
+    return machine_name(sdep.name, pred.spec.name)
+
+
+def container_service_name(sdep: SeldonDeployment, pred: PredictorExt,
+                           unit: PredictiveUnit) -> str:
+    return machine_name(sdep.name, pred.spec.name, unit.name)

@@ -1,0 +1,116 @@
+"""Defaulting + validating webhooks as pure functions.
+
+Reference: operator/api/v1alpha2/seldondeployment_webhook.go —
+DefaultSeldonDeployment (:137-351: port assignment from 9000+, endpoint
+service hosts, prepackaged-server container materialization, type
+defaulting) and ValidateCreate (:358-424: graph/container match, modelUri
+required for prepack, unique predictor names, traffic sums to 100)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from seldon_tpu.operator import types as T
+from seldon_tpu.orchestrator.spec import (
+    Endpoint,
+    EndpointType,
+    PredictiveUnit,
+    UnitImplementation,
+    default_unit_types,
+    validate_spec,
+)
+
+PREPACKAGED = {
+    UnitImplementation.SKLEARN_SERVER,
+    UnitImplementation.XGBOOST_SERVER,
+    UnitImplementation.TENSORFLOW_SERVER,
+    UnitImplementation.MLFLOW_SERVER,
+    UnitImplementation.JAX_SERVER,
+}
+
+# Server class loaded by the microservice CLI per implementation
+# (reference materializes docker images, operator/constants/constants.go:4-13;
+# here one image + class selection via parameters).
+PREPACKAGED_CLASSES = {
+    UnitImplementation.SKLEARN_SERVER: "seldon_tpu.servers.sklearnserver.SKLearnServer",
+    UnitImplementation.XGBOOST_SERVER: "seldon_tpu.servers.xgboostserver.XGBoostServer",
+    UnitImplementation.MLFLOW_SERVER: "seldon_tpu.servers.mlflowserver.MLFlowServer",
+    UnitImplementation.TENSORFLOW_SERVER: "seldon_tpu.servers.tfproxy.TFServingProxy",
+    UnitImplementation.JAX_SERVER: "seldon_tpu.servers.jaxserver.JAXServer",
+}
+
+
+def default_deployment(sdep: T.SeldonDeployment) -> T.SeldonDeployment:
+    """Fill defaults in place (and return it): unit types, ports, service
+    hosts, prepackaged images/classes."""
+    for pred in sdep.predictors:
+        default_unit_types(pred.spec.graph)
+        separate_engine = (
+            sdep.annotations.get(T.ANNOTATION_SEPARATE_ENGINE, "false")
+            == "true"
+        )
+        port = T.FIRST_UNIT_PORT
+        for unit in pred.spec.graph.walk():
+            if unit.implementation in PREPACKAGED and not unit.image:
+                unit.image = T.DEFAULT_SERVER_IMAGE
+                pred.component_images.setdefault(unit.name, unit.image)
+            needs_endpoint = (
+                unit.implementation
+                not in (
+                    UnitImplementation.SIMPLE_MODEL,
+                    UnitImplementation.SIMPLE_ROUTER,
+                    UnitImplementation.RANDOM_ABTEST,
+                    UnitImplementation.AVERAGE_COMBINER,
+                )
+            )
+            if not needs_endpoint:
+                continue
+            if unit.endpoint is None:
+                unit.endpoint = Endpoint(type=EndpointType.GRPC)
+            if unit.endpoint.service_port in (0, T.FIRST_UNIT_PORT) and (
+                unit.endpoint.service_port != port
+            ):
+                unit.endpoint.service_port = port
+            port = max(port, unit.endpoint.service_port) + 1
+            # Engine shares the pod with units unless separate-pod: then
+            # units resolve via their container service DNS
+            # (webhook.go:224-231).
+            if not unit.endpoint.service_host or unit.endpoint.service_host == "localhost":
+                if separate_engine:
+                    unit.endpoint.service_host = (
+                        f"{T.container_service_name(sdep, pred, unit)}."
+                        f"{sdep.namespace}.svc.cluster.local."
+                    )
+                else:
+                    unit.endpoint.service_host = "localhost"
+    return sdep
+
+
+def validate_deployment(sdep: T.SeldonDeployment) -> List[str]:
+    problems: List[str] = []
+    if not sdep.predictors:
+        problems.append("deployment has no predictors")
+    names = [p.spec.name for p in sdep.predictors]
+    if len(set(names)) != len(names):
+        problems.append(f"duplicate predictor names: {names}")
+    traffic = sum(p.spec.traffic for p in sdep.predictors)
+    if len(sdep.predictors) > 1 and traffic != 100:
+        problems.append(
+            f"traffic must sum to 100 across predictors, got {traffic}"
+        )
+    for pred in sdep.predictors:
+        problems.extend(
+            f"predictor {pred.spec.name!r}: {p}"
+            for p in validate_spec(pred.spec)
+        )
+        if pred.tpu.chips:
+            if pred.tpu.hosts < 1:
+                problems.append(
+                    f"predictor {pred.spec.name!r}: tpu.hosts must be >= 1"
+                )
+            if pred.tpu.hosts > 1 and not pred.tpu.topology:
+                problems.append(
+                    f"predictor {pred.spec.name!r}: multi-host tpu requires "
+                    "an explicit topology"
+                )
+    return problems

@@ -117,12 +117,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         )
 
 
-def _flash_pallas(q, k, v, causal, q_offset, block_q, block_k):
+def _flash_pallas(q, k, v, causal, q_offset, block_q, block_k, q_per_kv=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     BH, Sq, Dh = q.shape
     Skv = k.shape[1]
+    if k.shape[0] * q_per_kv != BH:
+        raise ValueError(
+            f"kv rows {k.shape[0]} x group {q_per_kv} != q rows {BH}"
+        )
     block_q = min(block_q, Sq)
     block_k = min(block_k, Skv)
     if Sq % block_q or Skv % block_k:
@@ -142,6 +146,9 @@ def _flash_pallas(q, k, v, causal, q_offset, block_q, block_k):
         scale=scale,
         q_offset=q_offset,
     )
+    # GQA: kv stays [B*Hkv, S, Dh]; the index_map folds each group of
+    # q_per_kv query heads onto its shared kv row — no jnp.repeat, no
+    # HBM duplication of K/V.
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -152,11 +159,13 @@ def _flash_pallas(q, k, v, causal, q_offset, block_q, block_k):
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_k, Dh), lambda b, i, j: (b, j, 0),
+                (1, block_k, Dh),
+                lambda b, i, j: (b // q_per_kv, j, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_k, Dh), lambda b, i, j: (b, j, 0),
+                (1, block_k, Dh),
+                lambda b, i, j: (b // q_per_kv, j, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -180,28 +189,53 @@ def _on_tpu() -> bool:
     return platform in ("tpu", "axon")
 
 
+def _expand_kv(x: jnp.ndarray, q_per_kv: int) -> jnp.ndarray:
+    if q_per_kv == 1:
+        return x
+    BHkv, S, Dh = x.shape
+    return jnp.repeat(x, q_per_kv, axis=0)
+
+
 def flash_attention(
-    q: jnp.ndarray,  # [BH, Sq, Dh]
-    k: jnp.ndarray,  # [BH, Skv, Dh]
+    q: jnp.ndarray,  # [B*H, Sq, Dh]
+    k: jnp.ndarray,  # [B*Hkv, Skv, Dh] (Hkv == H / q_per_kv)
     v: jnp.ndarray,
     causal: bool = True,
     q_offset: int = 0,
+    q_per_kv: int = 1,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     force_pallas: bool = False,
     force_reference: bool = False,
 ) -> jnp.ndarray:
-    """Blockwise attention; pallas on TPU, jnp reference elsewhere."""
+    """Blockwise attention; pallas on TPU, jnp reference elsewhere. GQA is
+    native in the kernel (kv block index_map); only the reference fallback
+    pays a repeat."""
     if force_reference:
-        return attention_reference(q, k, v, causal, q_offset)
+        return attention_reference(
+            q, _expand_kv(k, q_per_kv), _expand_kv(v, q_per_kv), causal,
+            q_offset,
+        )
     use_pallas = force_pallas or _on_tpu()
     divisible = (
         q.shape[1] % min(block_q, q.shape[1]) == 0
         and k.shape[1] % min(block_k, k.shape[1]) == 0
     )
+    if use_pallas and not divisible:
+        if force_pallas:
+            raise ValueError(
+                f"flash kernel needs divisible blocks: Sq={q.shape[1]}, "
+                f"Skv={k.shape[1]}, blocks=({block_q},{block_k})"
+            )
+        logger.warning(
+            "flash attention bypassed: Sq=%d/Skv=%d not divisible by blocks "
+            "(%d,%d); running O(S^2) reference attention",
+            q.shape[1], k.shape[1], block_q, block_k,
+        )
     if use_pallas and divisible:
         try:
-            return _flash_pallas(q, k, v, causal, q_offset, block_q, block_k)
+            return _flash_pallas(q, k, v, causal, q_offset, block_q, block_k,
+                                 q_per_kv)
         except Exception:  # pragma: no cover - backend quirks
             if force_pallas:
                 raise
@@ -209,4 +243,6 @@ def flash_attention(
                 "pallas flash attention failed; falling back to the O(S^2) "
                 "reference path (shapes q=%s k=%s)", q.shape, k.shape,
             )
-    return attention_reference(q, k, v, causal, q_offset)
+    return attention_reference(
+        q, _expand_kv(k, q_per_kv), _expand_kv(v, q_per_kv), causal, q_offset
+    )

@@ -1,0 +1,237 @@
+"""Operator tests — mirror the reference's envtest assertions
+(operator/controllers/seldondeployment_controller_test.go:1-138: created
+Deployment shape from a CR fixture; webhook tests; ambassador golden)."""
+
+import base64
+import json
+
+import pytest
+
+from seldon_tpu.operator import (
+    InMemoryStore,
+    Reconciler,
+    SeldonDeployment,
+    default_deployment,
+    machine_name,
+    validate_deployment,
+)
+from seldon_tpu.operator import types as T
+from seldon_tpu.operator.reconciler import (
+    DEPLOYMENT_LABEL,
+    ENGINE_LABEL,
+    GENERATION_LABEL,
+    ambassador_annotations,
+    build_istio_manifests,
+)
+
+
+def fixture_cr(name="mymodel", generation=1, tpu=None, predictors=None):
+    pred = {
+        "name": "main",
+        "replicas": 1,
+        "graph": {
+            "name": "classifier",
+            "type": "MODEL",
+            "implementation": "JAX_SERVER",
+            "modelUri": "file:///models/demo",
+        },
+    }
+    if tpu:
+        pred["tpu"] = tpu
+    return SeldonDeployment.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "test",
+                         "generation": generation},
+            "spec": {"predictors": predictors or [pred]},
+        }
+    )
+
+
+def test_machine_name_truncation():
+    n = machine_name("a" * 100, "b")
+    assert len(n) <= 63
+    assert machine_name("MyModel", "p") == "mymodel-p"
+    # Deterministic.
+    assert machine_name("a" * 100, "b") == machine_name("a" * 100, "b")
+
+
+def test_defaulting_assigns_ports_and_hosts():
+    sdep = fixture_cr()
+    default_deployment(sdep)
+    unit = sdep.predictors[0].spec.graph
+    assert unit.endpoint is not None
+    assert unit.endpoint.service_port == 9000
+    assert unit.endpoint.service_host == "localhost"
+    assert unit.image == T.DEFAULT_SERVER_IMAGE
+
+
+def test_defaulting_separate_engine_uses_svc_dns():
+    sdep = fixture_cr()
+    sdep.annotations[T.ANNOTATION_SEPARATE_ENGINE] = "true"
+    default_deployment(sdep)
+    unit = sdep.predictors[0].spec.graph
+    assert unit.endpoint.service_host.endswith(".test.svc.cluster.local.")
+
+
+def test_validation_catches_problems():
+    sdep = fixture_cr()
+    sdep.predictors[0].spec.graph.model_uri = ""
+    default_deployment(sdep)
+    problems = validate_deployment(sdep)
+    assert any("modelUri" in p for p in problems)
+
+    two = fixture_cr(
+        predictors=[
+            {"name": "a", "traffic": 50,
+             "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}},
+            {"name": "b", "traffic": 40,
+             "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}},
+        ]
+    )
+    problems = validate_deployment(two)
+    assert any("traffic" in p for p in problems)
+
+
+def test_reconcile_creates_deployment_shape():
+    store = InMemoryStore()
+    sdep = fixture_cr()
+    status = Reconciler(store).reconcile(sdep)
+    assert status.state == "Available"
+
+    deps = store.list("Deployment", "test")
+    assert len(deps) == 1
+    pod = deps[0]["spec"]["template"]["spec"]
+    names = [c["name"] for c in pod["containers"]]
+    assert "classifier" in names
+    assert "seldon-container-engine" in names
+    # Engine carries the base64 graph spec.
+    engine = next(c for c in pod["containers"]
+                  if c["name"] == "seldon-container-engine")
+    env = {e["name"]: e["value"] for e in engine["env"]}
+    graph = json.loads(base64.b64decode(env[T.ENV_ENGINE_PREDICTOR]))
+    assert graph["graph"]["name"] == "classifier"
+    # Model initializer + shared volume.
+    assert pod["initContainers"][0]["name"] == "classifier-model-initializer"
+    assert pod["volumes"][0]["name"] == "model-volume"
+    # Unit container env.
+    unit = next(c for c in pod["containers"] if c["name"] == "classifier")
+    uenv = {e["name"]: e["value"] for e in unit["env"]}
+    assert uenv[T.ENV_PREDICTIVE_UNIT_SERVICE_PORT] == "9000"
+    assert uenv[T.ENV_SELDON_DEPLOYMENT_ID] == "mymodel"
+    params = json.loads(uenv[T.ENV_PREDICTIVE_UNIT_PARAMETERS])
+    assert {"name": "model_uri", "value": "/mnt/models",
+            "type": "STRING"} in params
+    # Services: predictor svc exists.
+    svcs = store.list("Service", "test")
+    assert any(s["metadata"]["name"] == "mymodel-main" for s in svcs)
+
+
+def test_reconcile_tpu_placement():
+    store = InMemoryStore()
+    sdep = fixture_cr(tpu={"chips": 4, "topology": "2x2",
+                           "accelerator": "tpu-v5-lite-podslice"})
+    Reconciler(store).reconcile(sdep)
+    pod = store.list("Deployment", "test")[0]["spec"]["template"]["spec"]
+    sel = pod["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    unit = next(c for c in pod["containers"] if c["name"] == "classifier")
+    assert unit["resources"]["limits"]["google.com/tpu"] == 4
+
+
+def test_reconcile_multihost_statefulset():
+    store = InMemoryStore()
+    sdep = fixture_cr(tpu={"chips": 4, "topology": "2x4", "hosts": 2})
+    status = Reconciler(store).reconcile(sdep)
+    assert status.state == "Available"
+    sts = store.list("StatefulSet", "test")
+    assert len(sts) == 1
+    assert sts[0]["spec"]["replicas"] == 2  # hosts x replicas
+    assert sts[0]["spec"]["serviceName"].endswith("-hosts")
+    headless = [
+        s for s in store.list("Service", "test")
+        if s["spec"].get("clusterIP") == "None"
+    ]
+    assert len(headless) == 1
+
+
+def test_rolling_update_gc_engine_last():
+    """Generation bump with a renamed predictor: old resources deleted,
+    engine-labeled ones ordered last; nothing deleted while not ready."""
+    store = InMemoryStore()
+    r = Reconciler(store)
+    sdep = fixture_cr(generation=1)
+    r.reconcile(sdep)
+    old_dep = store.list("Deployment", "test")[0]["metadata"]["name"]
+
+    # New generation renames the predictor -> new resource names.
+    sdep2 = fixture_cr(generation=2)
+    sdep2.predictors[0].spec.name = "canary"
+
+    # While the new deployment is not ready, stale resources survive.
+    new_name = T.predictor_deployment_name(sdep2, sdep2.predictors[0])
+    store.not_ready.add(("Deployment", "test", new_name))
+    status = r.reconcile(sdep2)
+    assert status.state == "Creating"
+    names = [d["metadata"]["name"] for d in store.list("Deployment", "test")]
+    assert old_dep in names  # old engine still draining
+
+    # Ready -> stale generation GC'd.
+    store.not_ready.clear()
+    status = r.reconcile(sdep2)
+    assert status.state == "Available"
+    names = [d["metadata"]["name"] for d in store.list("Deployment", "test")]
+    assert old_dep not in names
+    assert new_name in names
+
+
+def test_istio_traffic_weights():
+    sdep = fixture_cr(
+        predictors=[
+            {"name": "a", "traffic": 75,
+             "graph": {"name": "m1", "implementation": "SIMPLE_MODEL"}},
+            {"name": "b", "traffic": 25,
+             "graph": {"name": "m2", "implementation": "SIMPLE_MODEL"}},
+        ]
+    )
+    default_deployment(sdep)
+    manifests = build_istio_manifests(sdep)
+    vs = [m for m in manifests if m["kind"] == "VirtualService"][0]
+    weights = [r["weight"] for r in vs["spec"]["http"][0]["route"]]
+    assert weights == [75, 25]
+    assert len([m for m in manifests if m["kind"] == "DestinationRule"]) == 2
+
+
+def test_ambassador_yaml():
+    sdep = fixture_cr()
+    default_deployment(sdep)
+    yaml_block = ambassador_annotations(sdep)
+    assert "prefix: /seldon/test/mymodel/" in yaml_block
+    assert "grpc: true" in yaml_block
+    assert "retry_on: connect-failure" in yaml_block
+    import yaml as pyyaml
+
+    docs = [d for d in pyyaml.safe_load_all(yaml_block) if d]
+    assert len(docs) == 2
+
+
+def test_separate_engine_pod():
+    store = InMemoryStore()
+    sdep = fixture_cr()
+    sdep.annotations[T.ANNOTATION_SEPARATE_ENGINE] = "true"
+    Reconciler(store).reconcile(sdep)
+    deps = store.list("Deployment", "test")
+    assert len(deps) == 2
+    engine_deps = [
+        d for d in deps
+        if d["metadata"]["labels"].get(ENGINE_LABEL) == "true"
+    ]
+    assert len(engine_deps) == 1
+    pods = [
+        d for d in deps
+        if d["metadata"]["labels"].get(ENGINE_LABEL) != "true"
+    ]
+    unit_pod = pods[0]["spec"]["template"]["spec"]
+    assert all(
+        c["name"] != "seldon-container-engine" for c in unit_pod["containers"]
+    )

@@ -123,3 +123,41 @@ def test_forward_flash_flag_matches_xla():
     out = forward(params, tokens, flash_cfg)
     np.testing.assert_allclose(np.asarray(base), np.asarray(out), rtol=2e-2,
                                atol=2e-2)
+
+
+def test_flash_gqa_native_interpret():
+    """GQA via kv index_map == expanded-kv reference (interpret mode)."""
+    import importlib
+    from unittest import mock
+
+    from jax.experimental import pallas as pl
+
+    fa = importlib.import_module("seldon_tpu.ops.flash_attention")
+    B, H, Hkv, S, Dh = 2, 4, 2, 32, 8
+    G = H // Hkv
+    key = jax.random.key(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B * H, S, Dh))
+    k = jax.random.normal(kk, (B * Hkv, S, Dh))
+    v = jax.random.normal(kv, (B * Hkv, S, Dh))
+    ref = attention_reference(
+        q, jnp.repeat(k, G, axis=0), jnp.repeat(v, G, axis=0), causal=True
+    )
+
+    orig = pl.pallas_call
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    with mock.patch.object(pl, "pallas_call", interp):
+        out = fa._flash_pallas(q, k, v, True, 0, 16, 16, q_per_kv=G)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_config_rejects_bad_attn_impl():
+    from seldon_tpu.models import get_config
+
+    with pytest.raises(AssertionError):
+        get_config("tiny", attn_impl="Flash")
