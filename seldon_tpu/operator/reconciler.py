@@ -149,15 +149,21 @@ def _unit_container(sdep: T.SeldonDeployment, pred: T.PredictorExt,
     if resources:
         container["resources"] = resources
     if unit.model_uri:
+        # Per-unit volume: two prepackaged units in one graph must never
+        # clobber each other's /mnt/models downloads.
         container["volumeMounts"] = [
-            {"name": "model-volume", "mountPath": "/mnt/models",
+            {"name": _model_volume_name(unit), "mountPath": "/mnt/models",
              "readOnly": True}
         ]
     return container
 
 
+def _model_volume_name(unit: PredictiveUnit) -> str:
+    return T.machine_name("model-volume", unit.name)
+
+
 def _model_initializer(unit: PredictiveUnit) -> Dict:
-    """initContainer downloading modelUri into the shared volume
+    """initContainer downloading modelUri into the unit's volume
     (reference model_initializer_injector.go:65-228)."""
     return {
         "name": f"{unit.name}-model-initializer",
@@ -168,7 +174,7 @@ def _model_initializer(unit: PredictiveUnit) -> Dict:
             f"download({unit.model_uri!r}, '/mnt/models')",
         ],
         "volumeMounts": [
-            {"name": "model-volume", "mountPath": "/mnt/models"}
+            {"name": _model_volume_name(unit), "mountPath": "/mnt/models"}
         ],
     }
 
@@ -230,16 +236,15 @@ def build_predictor_manifests(
     containers = []
     init_containers = []
     volumes = []
-    needs_model_volume = False
     for unit in pred.spec.graph.walk():
         if unit.implementation in HARDCODED_IMPLEMENTATIONS:
             continue
         containers.append(_unit_container(sdep, pred, unit))
         if unit.model_uri:
             init_containers.append(_model_initializer(unit))
-            needs_model_volume = True
-    if needs_model_volume:
-        volumes.append({"name": "model-volume", "emptyDir": {}})
+            volumes.append(
+                {"name": _model_volume_name(unit), "emptyDir": {}}
+            )
 
     engine = _engine_container(sdep, pred)
     engine_labels = dict(labels)
@@ -301,15 +306,23 @@ def build_predictor_manifests(
     }
     if multi_host:
         # Stable ordinals for jax.distributed: pod-0..pod-(hosts-1) form one
-        # slice; headless service gives them DNS identity.
+        # slice; headless service gives them DNS identity. The env goes on
+        # the container(s) holding the TPU resources (all units if none do).
         headless_name = f"{dep_name}-hosts"
         workload["spec"]["serviceName"] = headless_name
-        pod_spec["containers"][0].setdefault("env", []).extend(
-            [
-                {"name": "TPU_WORKER_HOSTNAMES_SVC", "value": headless_name},
-                {"name": "TPU_WORKER_COUNT", "value": str(pred.tpu.hosts)},
-            ]
-        )
+        tpu_containers = [
+            c for c in containers
+            if "google.com/tpu" in c.get("resources", {}).get("limits", {})
+        ] or containers
+        for c in tpu_containers:
+            c.setdefault("env", []).extend(
+                [
+                    {"name": "TPU_WORKER_HOSTNAMES_SVC",
+                     "value": headless_name},
+                    {"name": "TPU_WORKER_COUNT",
+                     "value": str(pred.tpu.hosts)},
+                ]
+            )
         manifests.append(
             {
                 "apiVersion": "v1",
@@ -361,7 +374,10 @@ def build_predictor_manifests(
             if unit.implementation in HARDCODED_IMPLEMENTATIONS:
                 continue
             svc = T.container_service_name(sdep, pred, unit)
-            port = unit.endpoint.service_port if unit.endpoint else 9000
+            port = (
+                unit.endpoint.service_port if unit.endpoint
+                else T.FIRST_UNIT_PORT
+            )
             manifests.append(
                 {
                     "apiVersion": "v1",
@@ -466,8 +482,6 @@ def build_istio_manifests(sdep: T.SeldonDeployment) -> List[Dict]:
 
 def ambassador_annotations(sdep: T.SeldonDeployment) -> str:
     """Ambassador v1 Mapping YAML block (reference ambassador.go:50-263)."""
-    import io
-
     blocks = []
     for pred in sdep.predictors:
         svc = T.predictor_service_name(sdep, pred)

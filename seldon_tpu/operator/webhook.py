@@ -41,8 +41,9 @@ PREPACKAGED_CLASSES = {
 
 
 def default_deployment(sdep: T.SeldonDeployment) -> T.SeldonDeployment:
-    """Fill defaults in place (and return it): unit types, ports, service
-    hosts, prepackaged images/classes."""
+    """Fill defaults in place (and return it): traffic split, unit types,
+    ports, service hosts, prepackaged images/classes."""
+    _default_traffic(sdep)
     for pred in sdep.predictors:
         default_unit_types(pred.spec.graph)
         separate_engine = (
@@ -84,6 +85,23 @@ def default_deployment(sdep: T.SeldonDeployment) -> T.SeldonDeployment:
                 else:
                     unit.endpoint.service_host = "localhost"
     return sdep
+
+
+def _default_traffic(sdep: T.SeldonDeployment) -> None:
+    """Distribute unset (0) traffic: single predictor gets 100; with
+    multiple, unset predictors split what the explicit ones left over."""
+    preds = sdep.predictors
+    if not preds:
+        return
+    unset = [p for p in preds if p.spec.traffic == 0]
+    if not unset:
+        return
+    remainder = 100 - sum(p.spec.traffic for p in preds)
+    if remainder <= 0:
+        return  # explicit values already (over)claim; validation reports
+    share, extra = divmod(remainder, len(unset))
+    for i, p in enumerate(unset):
+        p.spec.traffic = share + (1 if i < extra else 0)
 
 
 def validate_deployment(sdep: T.SeldonDeployment) -> List[str]:

@@ -163,7 +163,9 @@ class PredictorSpec:
             name=d.get("name", "default"),
             graph=PredictiveUnit.from_dict(d["graph"]),
             replicas=int(d.get("replicas", 1)),
-            traffic=int(d.get("traffic", 100)),
+            # 0 = unset (proto3 default); the operator webhook distributes
+            # traffic across predictors at defaulting time.
+            traffic=int(d.get("traffic", 0)),
             labels=dict(d.get("labels", {})),
             annotations=dict(d.get("annotations", {})),
         )

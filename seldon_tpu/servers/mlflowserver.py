@@ -1,0 +1,46 @@
+"""MLFlow parity server (reference servers/mlflowserver/mlflowserver/
+MLFlowServer.py:12-49: mlflow.pyfunc.load_model, predict via DataFrame).
+
+mlflow is not baked into this image; the import is gated with a clear
+error. When present, behavior mirrors the reference."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from seldon_tpu.servers.storage import download
+
+
+class MLFlowServer:
+    def __init__(self, model_uri: str = ""):
+        self.model_uri = model_uri
+        self.model = None
+
+    def load(self) -> None:
+        try:
+            import mlflow.pyfunc
+        except ImportError as e:
+            raise RuntimeError(
+                "MLFlowServer requires mlflow, which is not in this image; "
+                "serve the underlying model via SKLearnServer/XGBoostServer/"
+                "JAXServer instead"
+            ) from e
+        local = download(self.model_uri)
+        self.model = mlflow.pyfunc.load_model(local)
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None):
+        if self.model is None:
+            self.load()
+        try:
+            import pandas as pd
+
+            df = pd.DataFrame(np.asarray(X), columns=list(names) or None)
+            return np.asarray(self.model.predict(df))
+        except ImportError:
+            return np.asarray(self.model.predict(np.asarray(X)))
+
+    def tags(self) -> Dict:
+        return {"server": "mlflowserver"}

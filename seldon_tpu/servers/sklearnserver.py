@@ -1,0 +1,107 @@
+"""SKLearn parity server (reference servers/sklearnserver/sklearnserver/
+SKLearnServer.py:15-44: joblib-load model.joblib, predict_proba|predict).
+
+TPU re-execution: linear-family models export to `model.npz`
+(coef, intercept, classes, kind) and predict as one jitted matmul+softmax
+on the chip. `model.joblib` still loads when sklearn/joblib exist in the
+image (they are not baked in — gated)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from seldon_tpu.servers.storage import download
+
+
+class SKLearnServer:
+    def __init__(self, model_uri: str = "", method: str = "predict_proba"):
+        self.model_uri = model_uri
+        self.method = method
+        self.model = None
+        self._jax_params: Optional[Dict[str, np.ndarray]] = None
+        self._predict_jit = None
+
+    def load(self) -> None:
+        local = download(self.model_uri)
+        npz = os.path.join(local, "model.npz")
+        joblib_path = os.path.join(local, "model.joblib")
+        if os.path.exists(npz):
+            data = np.load(npz, allow_pickle=False)
+            self._jax_params = {k: data[k] for k in data.files}
+            self._build_jax_predict()
+        elif os.path.exists(joblib_path):
+            try:
+                import joblib
+            except ImportError as e:
+                raise RuntimeError(
+                    "model.joblib needs joblib/sklearn (not in this image); "
+                    "export the model to model.npz (coef, intercept, classes)"
+                ) from e
+            self.model = joblib.load(joblib_path)
+        else:
+            raise FileNotFoundError(
+                f"no model.npz or model.joblib under {local}"
+            )
+
+    def _build_jax_predict(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        coef = jnp.asarray(self._jax_params["coef"], jnp.float32)
+        intercept = jnp.asarray(self._jax_params["intercept"], jnp.float32)
+        kind = str(self._jax_params.get("kind", np.array("logistic")))
+
+        @jax.jit
+        def fwd(X):
+            logits = X @ coef.T + intercept
+            if "logistic" in kind:
+                if logits.shape[-1] == 1:
+                    p1 = jax.nn.sigmoid(logits[:, 0])
+                    return jnp.stack([1 - p1, p1], axis=1)
+                return jax.nn.softmax(logits, axis=-1)
+            return logits
+
+        self._predict_jit = fwd
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None):
+        if self.model is None and self._predict_jit is None:
+            self.load()
+        X = np.asarray(X, dtype=np.float32)
+        if self._predict_jit is not None:
+            out = np.asarray(self._predict_jit(X))
+            if self.method == "predict":
+                return np.argmax(out, axis=-1)
+            return out
+        if self.method == "predict_proba" and hasattr(self.model, "predict_proba"):
+            return self.model.predict_proba(X)
+        return self.model.predict(X)
+
+    def class_names(self) -> List[str]:
+        if self._jax_params is not None and "classes" in self._jax_params:
+            return [str(c) for c in self._jax_params["classes"]]
+        classes = getattr(self.model, "classes_", None)
+        return [str(c) for c in classes] if classes is not None else []
+
+    def tags(self) -> Dict:
+        return {"server": "sklearnserver",
+                "backend": "jax" if self._predict_jit else "joblib"}
+
+
+def export_linear_model(path: str, coef, intercept, classes=None,
+                        kind: str = "logistic") -> str:
+    """Save a linear/logistic model as the portable model.npz."""
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, "model.npz")
+    arrays = {
+        "coef": np.atleast_2d(np.asarray(coef, np.float32)),
+        "intercept": np.atleast_1d(np.asarray(intercept, np.float32)),
+        "kind": np.array(kind),
+    }
+    if classes is not None:
+        arrays["classes"] = np.asarray([str(c) for c in classes])
+    np.savez(out, **arrays)
+    return out

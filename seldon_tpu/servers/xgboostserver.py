@@ -1,0 +1,70 @@
+"""XGBoost parity server (reference servers/xgboostserver/xgboostserver/
+XGBoostServer.py:10-26: Booster(model_file='model.bst') -> DMatrix predict).
+
+TPU re-execution: the model ships as `model.json` (an xgboost
+`get_dump(dump_format='json')` array, optionally wrapped with objective/
+base_score) and runs through the vectorized JAX traversal in ops/trees.py —
+branchless gathers on the chip instead of CPU pointer-chasing. Native
+`model.bst` loads only if xgboost exists in the image (gated)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from seldon_tpu.ops import trees
+from seldon_tpu.servers.storage import download
+
+
+class XGBoostServer:
+    def __init__(self, model_uri: str = "", objective: str = ""):
+        self.model_uri = model_uri
+        self.objective = objective
+        self.booster = None
+        self.ensemble: Optional[trees.TreeEnsemble] = None
+
+    def load(self) -> None:
+        local = download(self.model_uri)
+        json_path = os.path.join(local, "model.json")
+        bst_path = os.path.join(local, "model.bst")
+        if os.path.exists(json_path):
+            with open(json_path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict):  # wrapped form
+                dump = doc["trees"]
+                self.objective = self.objective or doc.get("objective", "reg")
+                base = float(doc.get("base_score", 0.0))
+            else:
+                dump = doc
+                base = 0.0
+            self.ensemble = trees.from_xgboost_json(dump, base_score=base)
+        elif os.path.exists(bst_path):
+            try:
+                import xgboost as xgb
+            except ImportError as e:
+                raise RuntimeError(
+                    "model.bst needs xgboost (not in this image); export the "
+                    "booster with get_dump(dump_format='json') to model.json"
+                ) from e
+            self.booster = xgb.Booster(model_file=bst_path)
+        else:
+            raise FileNotFoundError(f"no model.json or model.bst under {local}")
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None):
+        if self.booster is None and self.ensemble is None:
+            self.load()
+        X = np.asarray(X, dtype=np.float32)
+        if self.ensemble is not None:
+            obj = "binary" if "logistic" in (self.objective or "") else "reg"
+            return np.asarray(trees.predict(self.ensemble, X, objective=obj))
+        import xgboost as xgb
+
+        return self.booster.predict(xgb.DMatrix(X))
+
+    def tags(self) -> Dict:
+        return {"server": "xgboostserver",
+                "backend": "jax-trees" if self.ensemble is not None else "xgboost"}

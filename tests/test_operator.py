@@ -112,7 +112,7 @@ def test_reconcile_creates_deployment_shape():
     assert graph["graph"]["name"] == "classifier"
     # Model initializer + shared volume.
     assert pod["initContainers"][0]["name"] == "classifier-model-initializer"
-    assert pod["volumes"][0]["name"] == "model-volume"
+    assert pod["volumes"][0]["name"] == "model-volume-classifier"
     # Unit container env.
     unit = next(c for c in pod["containers"] if c["name"] == "classifier")
     uenv = {e["name"]: e["value"] for e in unit["env"]}
@@ -235,3 +235,83 @@ def test_separate_engine_pod():
     assert all(
         c["name"] != "seldon-container-engine" for c in unit_pod["containers"]
     )
+
+
+def test_traffic_defaulting():
+    """Unset traffic distributes: 2 predictors no traffic -> 50/50; canary
+    pattern (only canary set) gives main the remainder."""
+    sdep = fixture_cr(
+        predictors=[
+            {"name": "a", "graph": {"name": "m1",
+                                    "implementation": "SIMPLE_MODEL"}},
+            {"name": "b", "graph": {"name": "m2",
+                                    "implementation": "SIMPLE_MODEL"}},
+        ]
+    )
+    default_deployment(sdep)
+    assert [p.spec.traffic for p in sdep.predictors] == [50, 50]
+    assert validate_deployment(sdep) == []
+
+    canary = fixture_cr(
+        predictors=[
+            {"name": "main", "graph": {"name": "m1",
+                                       "implementation": "SIMPLE_MODEL"}},
+            {"name": "canary", "traffic": 10,
+             "graph": {"name": "m2", "implementation": "SIMPLE_MODEL"}},
+        ]
+    )
+    default_deployment(canary)
+    assert [p.spec.traffic for p in canary.predictors] == [90, 10]
+
+
+def test_two_prepackaged_units_get_separate_volumes():
+    store = InMemoryStore()
+    sdep = fixture_cr(
+        predictors=[{
+            "name": "p",
+            "graph": {
+                "name": "top", "type": "MODEL",
+                "implementation": "SKLEARN_SERVER",
+                "modelUri": "file:///models/a",
+                "children": [{
+                    "name": "leaf", "type": "MODEL",
+                    "implementation": "XGBOOST_SERVER",
+                    "modelUri": "file:///models/b",
+                }],
+            },
+        }]
+    )
+    Reconciler(store).reconcile(sdep)
+    pod = store.list("Deployment", "test")[0]["spec"]["template"]["spec"]
+    vols = {v["name"] for v in pod["volumes"]}
+    assert len(vols) == 2  # one per unit, no clobbering
+    for c in pod["containers"]:
+        if c["name"] in ("top", "leaf"):
+            assert c["volumeMounts"][0]["name"] == f"model-volume-{c['name']}"
+
+
+def test_multihost_env_targets_tpu_container():
+    store = InMemoryStore()
+    sdep = fixture_cr(
+        predictors=[{
+            "name": "p",
+            "graph": {
+                "name": "pre", "type": "TRANSFORMER",
+                "endpoint": {"service_port": 9500, "type": "GRPC"},
+                "image": "user/transformer:1",
+                "children": [{
+                    "name": "llm", "type": "MODEL",
+                    "implementation": "JAX_SERVER",
+                    "modelUri": "file:///models/llm",
+                }],
+            },
+            "tpu": {"chips": 4, "topology": "2x4", "hosts": 2},
+        }]
+    )
+    Reconciler(store).reconcile(sdep)
+    pod = store.list("StatefulSet", "test")[0]["spec"]["template"]["spec"]
+    llm = next(c for c in pod["containers"] if c["name"] == "llm")
+    env = {e["name"] for e in llm["env"]}
+    assert "TPU_WORKER_HOSTNAMES_SVC" in env
+    pre = next(c for c in pod["containers"] if c["name"] == "pre")
+    assert "TPU_WORKER_HOSTNAMES_SVC" not in {e["name"] for e in pre["env"]}

@@ -1,0 +1,154 @@
+"""Parity servers: sklearn (jax linear), xgboost (jax tree traversal),
+tfproxy (REST bridge against a fake TF-Serving endpoint)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_tpu.ops import trees
+from seldon_tpu.servers.sklearnserver import SKLearnServer, export_linear_model
+from seldon_tpu.servers.xgboostserver import XGBoostServer
+
+
+# ---------------------------------------------------------------------------
+# Tree ensemble evaluator
+# ---------------------------------------------------------------------------
+
+# A 2-tree ensemble in xgboost dump format:
+# tree0: f0 < 0.5 ? leaf 1.0 : (f1 < 2.0 ? leaf -1.0 : leaf 3.0)
+# tree1: f1 < 1.0 ? leaf 0.5 : leaf -0.5
+TREE0 = {
+    "nodeid": 0, "split": "f0", "split_condition": 0.5, "yes": 1, "no": 2,
+    "children": [
+        {"nodeid": 1, "leaf": 1.0},
+        {"nodeid": 2, "split": "f1", "split_condition": 2.0, "yes": 3,
+         "no": 4, "children": [
+             {"nodeid": 3, "leaf": -1.0},
+             {"nodeid": 4, "leaf": 3.0},
+         ]},
+    ],
+}
+TREE1 = {
+    "nodeid": 0, "split": "f1", "split_condition": 1.0, "yes": 1, "no": 2,
+    "children": [{"nodeid": 1, "leaf": 0.5}, {"nodeid": 2, "leaf": -0.5}],
+}
+
+
+def manual_predict(x):
+    t0 = 1.0 if x[0] < 0.5 else (-1.0 if x[1] < 2.0 else 3.0)
+    t1 = 0.5 if x[1] < 1.0 else -0.5
+    return t0 + t1
+
+
+def test_tree_ensemble_matches_manual():
+    ens = trees.from_xgboost_json([json.dumps(TREE0), json.dumps(TREE1)])
+    X = np.array(
+        [[0.0, 0.0], [1.0, 0.0], [1.0, 2.5], [0.4, 5.0], [0.6, 1.5]],
+        np.float32,
+    )
+    out = np.asarray(trees.predict(ens, X))
+    expected = np.array([manual_predict(x) for x in X])
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_tree_ensemble_binary_objective():
+    ens = trees.from_xgboost_json([json.dumps(TREE1)])
+    out = np.asarray(trees.predict(ens, np.array([[0.0, 0.0]]), "binary"))
+    assert 0.0 < out[0] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# XGBoostServer on the jax path
+# ---------------------------------------------------------------------------
+
+
+def test_xgboost_server_json(tmp_path):
+    model_dir = tmp_path / "xgb"
+    model_dir.mkdir()
+    (model_dir / "model.json").write_text(
+        json.dumps({"trees": [TREE0, TREE1], "objective": "reg",
+                    "base_score": 0.0})
+    )
+    srv = XGBoostServer(model_uri=str(model_dir))
+    srv.load()
+    out = srv.predict(np.array([[0.0, 0.0]], np.float32), [])
+    np.testing.assert_allclose(out, [1.5], rtol=1e-6)
+    assert srv.tags()["backend"] == "jax-trees"
+
+
+# ---------------------------------------------------------------------------
+# SKLearnServer on the jax path
+# ---------------------------------------------------------------------------
+
+
+def test_sklearn_server_npz_logistic(tmp_path):
+    # 3-class logistic: coef [3, 2].
+    coef = np.array([[1.0, 0.0], [0.0, 1.0], [-1.0, -1.0]])
+    intercept = np.array([0.0, 0.0, 0.0])
+    export_linear_model(str(tmp_path), coef, intercept,
+                        classes=["a", "b", "c"])
+    srv = SKLearnServer(model_uri=str(tmp_path))
+    srv.load()
+    probs = srv.predict(np.array([[5.0, 0.0]], np.float32), [])
+    assert probs.shape == (1, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+    assert np.argmax(probs[0]) == 0  # feature favors class a
+    assert srv.class_names() == ["a", "b", "c"]
+
+    srv2 = SKLearnServer(model_uri=str(tmp_path), method="predict")
+    srv2.load()
+    labels = srv2.predict(np.array([[0.0, 5.0]], np.float32), [])
+    assert labels[0] == 1
+
+
+def test_sklearn_server_binary_sigmoid(tmp_path):
+    export_linear_model(str(tmp_path), np.array([[2.0, -1.0]]),
+                        np.array([0.5]))
+    srv = SKLearnServer(model_uri=str(tmp_path))
+    srv.load()
+    probs = srv.predict(np.array([[1.0, 1.0]], np.float32), [])
+    assert probs.shape == (1, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# TFServingProxy against a fake TF-Serving REST endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_tfproxy_rest_roundtrip():
+    import http.server
+
+    class FakeTFS(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(
+                self.rfile.read(int(self.headers["Content-Length"]))
+            )
+            instances = body["instances"]
+            out = {"predictions": (np.asarray(instances) * 3.0).tolist()}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), FakeTFS)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        from seldon_tpu.servers.tfproxy import TFServingProxy
+
+        proxy = TFServingProxy(
+            rest_endpoint=f"http://127.0.0.1:{port}", model_name="m"
+        )
+        out = proxy.predict(np.array([[1.0, 2.0]]), [])
+        np.testing.assert_allclose(out, [[3.0, 6.0]])
+    finally:
+        httpd.shutdown()
