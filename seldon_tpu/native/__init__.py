@@ -1,0 +1,186 @@
+"""ctypes bindings to the C++ data-plane core (native/seldon_native.cc).
+
+Loads `libseldon_native.so` (built by `make -C native`; auto-built on first
+import when a compiler is present). Every entry point has a numpy fallback
+so the framework runs without the native library — `HAVE_NATIVE` reports
+which path is active."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libseldon_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "libseldon_native.so"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        logger.warning("native library build failed; using numpy fallbacks",
+                       exc_info=True)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None:
+        return _lib
+    if _load_attempted:
+        return None  # build/load already failed once; never retry per call
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
+        _build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        logger.warning("failed to load %s", _LIB_PATH, exc_info=True)
+        return None
+    lib.seldon_native_abi_version.restype = ctypes.c_int32
+    if lib.seldon_native_abi_version() != 1:
+        logger.warning("native ABI mismatch; using numpy fallbacks")
+        return None
+    lib.seldon_f32_to_bf16.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_uint16),
+        ctypes.c_int64,
+    ]
+    lib.seldon_bf16_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint16),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+    ]
+    lib.seldon_batch_fuse.restype = ctypes.c_int64
+    lib.seldon_batch_fuse.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.c_void_p,
+    ]
+    lib.seldon_batch_split.restype = ctypes.c_int64
+    lib.seldon_batch_split.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    _lib = lib
+    return lib
+
+
+HAVE_NATIVE = _load() is not None
+
+
+def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
+    """f32 array -> bf16 bit pattern as uint16 (round-to-nearest-even)."""
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    lib = _load()
+    out = np.empty(arr.shape, dtype=np.uint16)
+    if lib is not None:
+        lib.seldon_f32_to_bf16(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            arr.size,
+        )
+        return out
+    try:
+        import ml_dtypes
+
+        return arr.astype(ml_dtypes.bfloat16).view(np.uint16)
+    except ImportError:  # pragma: no cover
+        bits = arr.view(np.uint32)
+        lsb = (bits >> 16) & 1
+        rounded = ((bits + 0x7FFF + lsb) >> 16).astype(np.uint16)
+        # NaN guard (same as the C path): don't round NaN payloads to inf.
+        is_nan = (bits & 0x7FFFFFFF) > 0x7F800000
+        return np.where(
+            is_nan, ((bits >> 16) | 0x0040).astype(np.uint16), rounded
+        )
+
+
+def bf16_to_f32(arr: np.ndarray) -> np.ndarray:
+    """uint16 bf16 bit pattern -> f32."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint16)
+    lib = _load()
+    out = np.empty(arr.shape, dtype=np.float32)
+    if lib is not None:
+        lib.seldon_bf16_to_f32(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            arr.size,
+        )
+        return out
+    return (arr.astype(np.uint32) << 16).view(np.float32).reshape(arr.shape)
+
+
+def fuse_rows(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate row-batches along axis 0 (native memcpy path)."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    lib = _load()
+    if lib is None or not arrays:
+        return np.concatenate(arrays, axis=0)
+    dtype = arrays[0].dtype
+    trailing = arrays[0].shape[1:]
+    if any(a.dtype != dtype or a.shape[1:] != trailing for a in arrays):
+        return np.concatenate(arrays, axis=0)  # mixed: numpy handles errors
+    total_rows = sum(a.shape[0] for a in arrays)
+    out = np.empty((total_rows, *trailing), dtype=dtype)
+    srcs = (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
+    )
+    sizes = (ctypes.c_int64 * len(arrays))(*[a.nbytes for a in arrays])
+    written = lib.seldon_batch_fuse(
+        srcs, sizes, len(arrays), out.ctypes.data_as(ctypes.c_void_p)
+    )
+    assert written == out.nbytes, (written, out.nbytes)
+    return out
+
+
+def split_rows(arr: np.ndarray, row_counts: Sequence[int]) -> List[np.ndarray]:
+    """Split a fused batch back into per-request row groups (native memcpy
+    when available)."""
+    arr = np.ascontiguousarray(arr)
+    if sum(row_counts) != arr.shape[0]:
+        raise ValueError(
+            f"row_counts {row_counts} do not sum to batch {arr.shape[0]}"
+        )
+    lib = _load()
+    trailing = arr.shape[1:]
+    outs = [np.empty((n, *trailing), dtype=arr.dtype) for n in row_counts]
+    if lib is None:
+        row = 0
+        for n, o in zip(row_counts, outs):
+            o[...] = arr[row: row + n]
+            row += n
+        return outs
+    dsts = (ctypes.c_void_p * len(outs))(
+        *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs]
+    )
+    sizes = (ctypes.c_int64 * len(outs))(*[o.nbytes for o in outs])
+    consumed = lib.seldon_batch_split(
+        arr.ctypes.data_as(ctypes.c_void_p), sizes, len(outs), dsts
+    )
+    assert consumed == arr.nbytes, (consumed, arr.nbytes)
+    return outs

@@ -135,7 +135,9 @@ class MicroBatcher:
                     p.future.set_exception(e)
             return
 
-        fused = np.concatenate([p.arr for p in q], axis=0)
+        from seldon_tpu import native
+
+        fused = native.fuse_rows([p.arr for p in q])
         kind = q[0].kind
         req = payloads.build_message(fused, kind=kind)
         req.meta.puid = q[0].puid or "fused"

@@ -1,0 +1,51 @@
+"""C++ native core bindings (graceful numpy fallback when unbuilt)."""
+
+import numpy as np
+import pytest
+
+from seldon_tpu import native
+
+
+def test_bf16_roundtrip_matches_mldtypes():
+    import ml_dtypes
+
+    x = (np.random.default_rng(0).standard_normal(4096) * 50).astype(
+        np.float32
+    )
+    ours = native.f32_to_bf16(x)
+    ref = x.astype(ml_dtypes.bfloat16).view(np.uint16)
+    np.testing.assert_array_equal(ours, ref)
+    np.testing.assert_array_equal(
+        native.bf16_to_f32(ours), x.astype(ml_dtypes.bfloat16).astype(np.float32)
+    )
+
+
+def test_bf16_specials():
+    out = native.bf16_to_f32(
+        native.f32_to_bf16(np.array([np.nan, np.inf, -np.inf, 0.0], np.float32))
+    )
+    assert np.isnan(out[0])
+    assert out[1] == np.inf and out[2] == -np.inf and out[3] == 0.0
+
+
+def test_fuse_split_roundtrip():
+    rng = np.random.default_rng(1)
+    parts = [rng.standard_normal((i + 1, 3)).astype(np.float32)
+             for i in range(4)]
+    fused = native.fuse_rows(parts)
+    np.testing.assert_array_equal(fused, np.concatenate(parts))
+    back = native.split_rows(fused, [p.shape[0] for p in parts])
+    for a, b in zip(back, parts):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_split_rejects_bad_counts():
+    with pytest.raises(ValueError):
+        native.split_rows(np.zeros((4, 2)), [1, 1])
+
+
+def test_fuse_mixed_dtype_falls_back():
+    a = np.zeros((1, 2), np.float32)
+    b = np.zeros((1, 2), np.float64)
+    out = native.fuse_rows([a, b])  # numpy fallback promotes
+    assert out.shape == (2, 2)
