@@ -1,76 +1,114 @@
 """Benchmark entry: prints ONE JSON line for the driver.
 
-Measures end-to-end batched generation (prefill 128 + decode 128) on the
-`bench-1b` flagship config on whatever accelerator is visible (the driver
-runs this on one real TPU chip). Metric is requests/s/chip; vs_baseline is
-against the BASELINE.json north star of 1000 req/s on a v5e-8 slice,
-i.e. 125 req/s/chip.
+Measures the CONTINUOUS-BATCHING ENGINE under concurrent load (the real
+serving path, not bare `generate()`): N_REQ requests (prefill 128 +
+decode up to 128) are submitted together to an InferenceEngine with
+SLOTS decode lanes on the `bench-1b` flagship config, on whatever
+accelerator is visible (the driver runs this on one real TPU chip).
+
+Metric is requests/s/chip; vs_baseline is against the BASELINE.json
+north star of 1000 req/s on a v5e-8 slice, i.e. 125 req/s/chip.
 
 Reference baselines (SURVEY.md §6) measure the Java engine with a stub
 model (12k req/s REST / 28k gRPC on n1-standard-16) — orchestrator-only,
-no model compute; those get a separate orchestrator bench once the graph
-engine lands. This one measures what the reference never could: real
-transformer serving throughput per chip.
+no model compute; `bench_orchestrator.py` covers that comparison. This
+one measures what the reference never could: real transformer serving
+throughput per chip.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
-BATCH = 8
-PROMPT_LEN = 128
-NEW_TOKENS = 128
+# Env overrides are for local smoke-testing only (e.g. BENCH_PRESET=tiny
+# on CPU); the driver runs with the defaults.
+PRESET = os.environ.get("BENCH_PRESET", "bench-1b")
+SLOTS = int(os.environ.get("BENCH_SLOTS", 96))
+N_REQ = int(os.environ.get("BENCH_NREQ", 288))
+PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", 128))
+NEW_TOKENS = int(os.environ.get("BENCH_NEW", 128))
+DECODE_CHUNK = int(os.environ.get("BENCH_CHUNK", 32))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-
-    from seldon_tpu.models import get_config, init_params
-    from seldon_tpu.models.generate import generate
-
-    cfg = get_config("bench-1b")
-    params = init_params(cfg, jax.random.key(0))
-
-    tokens = jax.random.randint(
-        jax.random.key(1), (BATCH, PROMPT_LEN), 3, cfg.vocab_size
-    )
-    lens = jnp.full((BATCH,), PROMPT_LEN, jnp.int32)
-    temp = jnp.full((BATCH,), 0.7)
-    top_k = jnp.full((BATCH,), 40, jnp.int32)
-    top_p = jnp.full((BATCH,), 0.95)
-
     import numpy as np
 
-    def run(key):
-        out, out_lens = generate(
-            params, tokens, lens, key, temp, top_k, top_p, cfg, NEW_TOKENS
+    from seldon_tpu.models import get_config, init_params
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config(PRESET)
+    params = init_params(cfg, jax.random.key(0))
+
+    ecfg = EngineConfig(
+        max_slots=SLOTS,
+        # Tight cache window: prompt + completion + 1 slack slot. Decode
+        # reads the whole window every step, so slack is pure HBM tax.
+        max_seq_len=PROMPT_LEN + NEW_TOKENS + 1,
+        prompt_buckets=(PROMPT_LEN,),
+        max_admit=8,
+        decode_chunk=DECODE_CHUNK,
+    )
+    engine = InferenceEngine(params, cfg, ecfg)
+    engine.warmup()
+    engine.start()
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(3, cfg.vocab_size, size=(N_REQ, PROMPT_LEN))
+
+    def sp(i: int) -> SamplingParams:
+        # top_k=0/top_p=1: sample the full vocab — near-uniform logits on a
+        # random-init model make premature EOS negligible (~1/V per step).
+        return SamplingParams(
+            temperature=0.7,
+            top_k=0,
+            top_p=1.0,
+            max_new_tokens=NEW_TOKENS,
+            seed=i,
         )
-        # Materialize on host: under the axon tunnel block_until_ready can
-        # return before execution finishes, inflating throughput ~1000x.
-        return np.asarray(out)
 
-    run(jax.random.key(2))  # compile
-    n_iters = 3
+    # Settle run: a small closed-loop wave through the scheduler.
+    for q in [engine.submit(prompts[i].tolist(), sp(i)) for i in range(8)]:
+        while q.get() is not None:
+            pass
+
     t0 = time.perf_counter()
-    for i in range(n_iters):
-        run(jax.random.key(3 + i))
+    queues = [engine.submit(prompts[i].tolist(), sp(i)) for i in range(N_REQ)]
+    total_toks = 0
+    ttfts = []
+    for q in queues:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if "error" in item:
+                raise RuntimeError(item["error"])
+            total_toks += len(item["tokens"])
+            if "ttft_ms" in item:
+                ttfts.append(item["ttft_ms"])
     dt = time.perf_counter() - t0
+    engine.stop()
 
-    total_reqs = BATCH * n_iters
-    req_s = total_reqs / dt
-    tok_s = total_reqs * NEW_TOKENS / dt
+    req_s = N_REQ / dt
     print(
         json.dumps(
             {
-                "metric": "generate_req_per_s_per_chip",
+                "metric": "engine_req_per_s_per_chip",
                 "value": round(req_s, 3),
-                "unit": "req/s (batch8, prefill128+decode128, bench-1b bf16)",
+                "unit": (
+                    f"req/s (engine, {SLOTS} slots, {N_REQ} concurrent, "
+                    f"prefill{PROMPT_LEN}+decode{NEW_TOKENS}, {PRESET} bf16)"
+                ),
                 "vs_baseline": round(req_s / BASELINE_REQ_S_PER_CHIP, 3),
                 "detail": {
-                    "decode_tokens_per_s": round(tok_s, 1),
+                    "decode_tokens_per_s": round(total_toks / dt, 1),
+                    "total_tokens": total_toks,
+                    "p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 1),
+                    "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 1),
                     "device": str(jax.devices()[0]),
                 },
             }

@@ -25,31 +25,25 @@ class SamplingParams:
     seed: int = 0
 
 
-def sample(
-    logits: jnp.ndarray,  # [B, V] f32
-    key: jax.Array,
-    temperature: jnp.ndarray,  # [B] f32; 0 => greedy
+def _mask_top_k_top_p(
+    scaled: jnp.ndarray,  # [B, V] temperature-scaled logits
     top_k: jnp.ndarray,  # [B] int32; 0 => off
     top_p: jnp.ndarray,  # [B] f32; 1.0 => off
 ) -> jnp.ndarray:
-    """Returns sampled token ids [B]."""
-    B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
-
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / temp
-
+    """Apply top-k + top-p (nucleus) masks. O(V log V) per row (one sort) —
+    callers skip this entirely via lax.cond when every row has both off."""
+    B, V = scaled.shape
     # top-k: mask everything below the k-th largest logit per row.
     sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
     k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
     kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
 
-    # top-p (nucleus): keep the smallest prefix of the sorted distribution
-    # whose cumulative probability covers p; always keep the argmax (so
-    # top_p<=0 degrades to greedy rather than an all-masked row).
-    # The post-top-k sorted view is the first sort with ranks >= k masked —
-    # no second O(V log V) sort in the per-token hot loop.
+    # top-p: keep the smallest prefix of the sorted distribution whose
+    # cumulative probability covers p; always keep the argmax (so top_p<=0
+    # degrades to greedy rather than an all-masked row). The post-top-k
+    # sorted view is the first sort with ranks >= k masked — no second
+    # O(V log V) sort.
     sorted_logits = jnp.where(
         jnp.arange(V)[None, :] >= k[:, None], -jnp.inf, sorted_desc
     )
@@ -58,24 +52,53 @@ def sample(
     inside = cum - probs_sorted < jnp.maximum(top_p, 1e-9)[:, None]
     cut = jnp.where(inside, sorted_logits, jnp.inf)
     min_keep = jnp.min(cut, axis=-1, keepdims=True)
-    scaled = jnp.where(scaled < min_keep, -jnp.inf, scaled)
-
-    sampled = jax.random.categorical(key, scaled, axis=-1)
-    return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
+    return jnp.where(masked < min_keep, -jnp.inf, masked)
 
 
 def sample_per_row(
     logits: jnp.ndarray,  # [B, V]
     keys: jax.Array,  # [B] PRNG keys (one per row)
-    temperature: jnp.ndarray,
-    top_k: jnp.ndarray,
-    top_p: jnp.ndarray,
+    temperature: jnp.ndarray,  # [B] f32; 0 => greedy
+    top_k: jnp.ndarray,  # [B] int32; 0 => off
+    top_p: jnp.ndarray,  # [B] f32; 1.0 => off
 ) -> jnp.ndarray:
-    """Row-independent sampling: each row draws from its own key, so a
-    request's tokens are reproducible from (seed, position) no matter what
-    other requests share the batch (continuous-batching requirement)."""
+    """Row-independent sampling: each row draws Gumbel noise from its own
+    key, so a request's tokens are reproducible from (seed, position) no
+    matter what other requests share the batch (continuous-batching
+    requirement).
 
-    def one(l, k, t, tk, tp):
-        return sample(l[None], k, t[None], tk[None], tp[None])[0]
+    The hot path is Gumbel-argmax (== categorical); the top-k/top-p sort is
+    behind a batch-level lax.cond and costs nothing when no active row uses
+    them (the decode-loop common case)."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
 
-    return jax.vmap(one)(logits, keys, temperature, top_k, top_p)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    need_mask = jnp.any(top_k > 0) | jnp.any(top_p < 1.0)
+    scaled = jax.lax.cond(
+        need_mask,
+        lambda s: _mask_top_k_top_p(s, top_k, top_p),
+        lambda s: s,
+        scaled,
+    )
+
+    u = jax.vmap(
+        lambda k: jax.random.uniform(k, (V,), minval=1e-20, maxval=1.0)
+    )(keys)
+    gumbel = -jnp.log(-jnp.log(u))
+    sampled = jnp.argmax(scaled + gumbel, axis=-1)
+    return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] f32; 0 => greedy
+    top_k: jnp.ndarray,  # [B] int32; 0 => off
+    top_p: jnp.ndarray,  # [B] f32; 1.0 => off
+) -> jnp.ndarray:
+    """Batch sampling from one key (whole-batch generate path)."""
+    keys = jax.random.split(key, logits.shape[0])
+    return sample_per_row(keys=keys, logits=logits, temperature=temperature,
+                          top_k=top_k, top_p=top_p)

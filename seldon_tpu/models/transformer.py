@@ -195,7 +195,13 @@ def _block(
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
-    use_flash = cfg.attn_impl == "flash" and S > 1 and kv is None
+    # Flash covers the no-cache path AND whole-window cached prefill (the
+    # serving path: the sub-cache window equals the prompt bucket, so
+    # attention is causal over the fresh k/v and the cache write is just the
+    # fresh k/v themselves — no cache read needed).
+    use_flash = cfg.attn_impl == "flash" and S > 1 and (
+        kv is None or S == kv[0].shape[1]
+    )
 
     if use_flash:
         # Full-sequence causal path through the pallas flash kernel
@@ -214,7 +220,7 @@ def _block(
             .transpose(0, 2, 1, 3)
             .reshape(B, S, cfg.n_heads * Dh)
         )
-        new_kv = None
+        new_kv = None if kv is None else (k, v)
     elif kv is not None:
         ck, cv = kv
         if S == ck.shape[1]:

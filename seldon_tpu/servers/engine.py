@@ -7,27 +7,32 @@ answer, vLLM-style iteration-level scheduling mapped onto XLA's static-shape
 world:
 
  * A fixed pool of B slots shares one pre-allocated KV cache
-   [L, B, Smax, Hkv, Dh]; every decode iteration runs ONE jitted
-   decode+sample step over all slots (MXU-batched), so new requests join
-   and finished requests leave between steps without recompiling.
- * Prefill is per-request, bucketed to power-of-two prompt lengths (few
-   compile variants, static shapes), then spliced into the slot cache with
-   a jitted dynamic_update_slice.
- * The first token is sampled directly from prefill logits — TTFT is one
-   prefill, never blocked behind other requests' decode steps.
- * All host<->device traffic per step is O(B) ints (sampled tokens out),
-   so ICI/HBM stay busy and the Python loop stays off the critical path.
+   [L, B, Smax, Hkv, Dh]; decode runs in CHUNKS of `decode_chunk` steps —
+   one jitted `lax.scan` over all slots per dispatch — so the host pays
+   one dispatch + one sync per K tokens/slot instead of per token.
+   Per-row EOS/length termination inside the chunk is value-level masking.
+ * Admission is ONE fused jitted call per group: waiting requests with the
+   same prompt bucket are prefilled together [G, Sb] (G padded to a power
+   of two, bounding compile variants), scattered into their slots, first
+   tokens sampled, and slot state armed — all device-side, no host sync
+   until the boundary read.
+ * The scheduler dispatches all admissions, then the decode chunk, then
+   reads everything in one wave — device stays busy while the host waits,
+   and host round-trip latency is amortized over K steps x B slots.
+ * `warmup()` pre-compiles every (prompt-bucket x group-size) admission
+   variant plus the chunk step, so first requests never eat a compile.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import logging
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,16 +40,18 @@ import numpy as np
 
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
-from seldon_tpu.models.sampling import SamplingParams, sample, sample_per_row
+from seldon_tpu.models.sampling import SamplingParams, sample_per_row
 
 logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
-    max_slots: int = 8
+    max_slots: int = 32
     max_seq_len: int = 2048
     prompt_buckets: Sequence[int] = (32, 128, 512, 1024)
+    max_admit: int = 8  # largest batched-prefill group (power of two)
+    decode_chunk: int = 8  # decode steps per dispatch (latency/thruput knob)
     idle_sleep_s: float = 0.002
 
 
@@ -97,79 +104,149 @@ class InferenceEngine:
         self.ecfg = engine_cfg or EngineConfig()
         self.params = params
         self.mesh = mesh
-        B, Smax = self.ecfg.max_slots, self.ecfg.max_seq_len
-
-        # Device-resident slot state.
-        self._cache = transformer.init_cache(cfg, B, Smax)
-        self._last_tok = jnp.zeros((B,), jnp.int32)
-        self._pos = jnp.zeros((B,), jnp.int32)
-        self._active = jnp.zeros((B,), jnp.bool_)
-        self._active_host = np.zeros((B,), bool)  # control-flow mirror
-        self._temp = jnp.ones((B,), jnp.float32)
-        self._top_k = jnp.zeros((B,), jnp.int32)
-        self._top_p = jnp.ones((B,), jnp.float32)
-        self._seeds = jnp.zeros((B,), jnp.uint32)
+        B = self.ecfg.max_slots
 
         # Prompt buckets clamped to the cache window (empty -> whole window).
+        Smax = self.ecfg.max_seq_len
         self._buckets = tuple(
             b for b in self.ecfg.prompt_buckets if b <= Smax
         ) or (Smax,)
+
+        self._state = self._fresh_state()
+        self._active_host = np.zeros((B,), bool)  # control-flow mirror
 
         # Host-side bookkeeping.
         self._slots: List[Optional[_Request]] = [None] * B
         self._free: List[int] = list(range(B))
         self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._waiting: Deque[_Request] = collections.deque()
         self._rid = 0
         self._rid_lock = threading.Lock()
         self.stats = EngineStats()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-        self._jit_prefill = jax.jit(
-            functools.partial(self._prefill_impl, cfg=self.cfg),
-            static_argnames=(),
-        )
-        self._jit_insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._jit_decode = jax.jit(
-            functools.partial(self._decode_impl, cfg=self.cfg),
+        # Largest power of two <= min(max_admit, max_slots).
+        ma = max(1, min(self.ecfg.max_admit, B))
+        self._max_admit = 1 << (ma.bit_length() - 1)
+
+        self._jit_admit = jax.jit(
+            functools.partial(self._admit_impl, cfg=self.cfg),
             donate_argnums=(1,),
         )
+        self._jit_chunk = jax.jit(
+            functools.partial(
+                self._chunk_impl,
+                cfg=self.cfg,
+                n_steps=max(1, self.ecfg.decode_chunk),
+            ),
+            donate_argnums=(1,),
+        )
+
+    def _fresh_state(self) -> Dict[str, Any]:
+        B, Smax = self.ecfg.max_slots, self.ecfg.max_seq_len
+        return {
+            "cache": transformer.init_cache(self.cfg, B, Smax),
+            "last_tok": jnp.zeros((B,), jnp.int32),
+            "pos": jnp.zeros((B,), jnp.int32),
+            "active": jnp.zeros((B,), jnp.bool_),
+            "temp": jnp.ones((B,), jnp.float32),
+            "top_k": jnp.zeros((B,), jnp.int32),
+            "top_p": jnp.ones((B,), jnp.float32),
+            "seeds": jnp.zeros((B,), jnp.uint32),
+            "remaining": jnp.zeros((B,), jnp.int32),
+        }
 
     # --- jitted kernels -----------------------------------------------------
 
     @staticmethod
-    def _prefill_impl(params, tokens, plen, key, temp, top_k, top_p, *, cfg):
-        """tokens [1, Sb] -> (first sampled token [1], sub-cache k/v)."""
-        sub = transformer.init_cache(cfg, 1, tokens.shape[1])
-        logits, sub = transformer.prefill(params, tokens, plen, sub, cfg)
-        tok = sample(logits, key, temp, top_k, top_p)
-        return tok, sub["k"], sub["v"]
+    def _admit_impl(
+        params, state, toks, plens, seeds, temps, top_ks, top_ps,
+        max_news, slots, *, cfg,
+    ):
+        """Fused admission: prefill [G, Sb], scatter into cache slots, sample
+        first tokens, arm slot state. One dispatch, no host sync.
 
-    @staticmethod
-    def _insert_impl(cache, sub_k, sub_v, slot):
-        """Splice a prefilled [L,1,Sb,...] sub-cache into batch slot `slot`."""
-        k = jax.lax.dynamic_update_slice(
-            cache["k"], sub_k.astype(cache["k"].dtype), (0, slot, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache["v"], sub_v.astype(cache["v"].dtype), (0, slot, 0, 0, 0)
-        )
-        return {"k": k, "v": v}
-
-    @staticmethod
-    def _decode_impl(params, cache, last_tok, pos, active, seeds,
-                     temp, top_k, top_p, *, cfg):
-        """One iteration over every slot: feed last tokens, sample next.
-        Each row's key is (seed, position), so completions are reproducible
-        no matter which requests share the batch."""
-        logits, cache = transformer.decode_step(params, last_tok, pos, cache, cfg)
+        Each row's first token is keyed by fold_in(key(seed), plen), matching
+        the decode convention fold_in(key(seed), pos+1): the same seed and
+        prompt reproduce the completion regardless of co-batched traffic.
+        Duplicate slot indices (admission padding rows) carry identical data,
+        so the duplicate scatter writes are well-defined."""
+        G, Sb = toks.shape
+        sub = transformer.init_cache(cfg, G, Sb)
+        logits, sub = transformer.prefill(params, toks, plens, sub, cfg)
         keys = jax.vmap(
-            lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
-        )(seeds, pos)
-        tok = sample_per_row(logits, keys, temp, top_k, top_p)
-        tok = jnp.where(active, tok, cfg.pad_token_id)
-        pos = pos + active.astype(jnp.int32)
-        return cache, tok, pos
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(seeds, plens)
+        first = sample_per_row(logits, keys, temps, top_ks, top_ps)
+
+        cache = state["cache"]
+        Smax = cache["k"].shape[2]
+        first_done = (
+            (first == cfg.eos_token_id)
+            | (max_news <= 1)
+            | (plens + 1 >= Smax)
+        )
+        k = cache["k"].at[:, slots, :Sb].set(sub["k"].astype(cache["k"].dtype))
+        v = cache["v"].at[:, slots, :Sb].set(sub["v"].astype(cache["v"].dtype))
+        new_state = {
+            "cache": {"k": k, "v": v},
+            "last_tok": state["last_tok"].at[slots].set(first),
+            "pos": state["pos"].at[slots].set(plens),
+            "active": state["active"].at[slots].set(~first_done),
+            "temp": state["temp"].at[slots].set(temps),
+            "top_k": state["top_k"].at[slots].set(top_ks),
+            "top_p": state["top_p"].at[slots].set(top_ps),
+            "seeds": state["seeds"].at[slots].set(seeds),
+            "remaining": state["remaining"].at[slots].set(max_news - 1),
+        }
+        return new_state, first, first_done
+
+    @staticmethod
+    def _chunk_impl(params, state, *, cfg, n_steps):
+        """`n_steps` decode iterations over every slot in one lax.scan.
+        Per-row termination (EOS / length budget / cache window) is
+        value-level: finished rows stop advancing and emit invalid tokens
+        until the chunk boundary. Returns (state, toks [K,B], valid [K,B])."""
+        Smax = state["cache"]["k"].shape[2]
+
+        def step(carry, _):
+            run = carry["active"]
+            logits, cache = transformer.decode_step(
+                params, carry["last_tok"], carry["pos"], carry["cache"], cfg
+            )
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
+            )(carry["seeds"], carry["pos"])
+            # Mask inactive rows' knobs so stale top_k/top_p in freed slots
+            # can't force the sampler's O(V log V) masking path forever.
+            tok = sample_per_row(
+                logits,
+                keys,
+                carry["temp"],
+                jnp.where(run, carry["top_k"], 0),
+                jnp.where(run, carry["top_p"], 1.0),
+            )
+            tok = jnp.where(run, tok, cfg.pad_token_id)
+            pos = carry["pos"] + run.astype(jnp.int32)
+            remaining = carry["remaining"] - run.astype(jnp.int32)
+            done = run & (
+                (tok == cfg.eos_token_id)
+                | (remaining <= 0)
+                | (pos >= Smax - 1)
+            )
+            new_carry = {
+                **carry,
+                "cache": cache,
+                "last_tok": jnp.where(run, tok, carry["last_tok"]),
+                "pos": pos,
+                "active": carry["active"] & ~done,
+                "remaining": remaining,
+            }
+            return new_carry, (tok, run)
+
+        state, (toks, valid) = jax.lax.scan(step, state, None, length=n_steps)
+        return state, toks, valid, state["active"]
 
     # --- public API ---------------------------------------------------------
 
@@ -177,7 +254,9 @@ class InferenceEngine:
         self, tokens: Sequence[int], params: Optional[SamplingParams] = None
     ) -> "queue.Queue[Optional[dict]]":
         """Enqueue a request. Returns a queue yielding
-        {"token": int, "ttft_ms": float?} dicts, then None at end."""
+        {"tokens": [int, ...], "ttft_ms": float?} dicts (one per scheduler
+        boundary — tokens arrive in decode-chunk bursts), then None at
+        end."""
         params = params or SamplingParams()
         if len(tokens) == 0:
             raise ValueError("empty prompt")
@@ -211,7 +290,7 @@ class InferenceEngine:
             if "error" in item:
                 error = item["error"]
                 continue
-            toks.append(item["token"])
+            toks.extend(item["tokens"])
             if ttft_ms is None:
                 ttft_ms = item.get("ttft_ms")
         if error is not None:
@@ -230,6 +309,39 @@ class InferenceEngine:
             self._thread.join(timeout=10)
             self._thread = None
 
+    def warmup(self) -> None:
+        """Pre-compile every (prompt-bucket x group-size) admission variant
+        plus the decode chunk, so live traffic never eats a compile. Not
+        thread-safe against the scheduler: call before start() (or while no
+        requests are in flight)."""
+        sizes = []
+        g = 1
+        while g <= self._max_admit:
+            sizes.append(g)
+            g *= 2
+        for Sb in self._buckets:
+            for G in sizes:
+                # max_new=1 -> rows are first_done; no slot state leaks.
+                self._state, _, _ = self._jit_admit(
+                    self.params,
+                    self._state,
+                    jnp.zeros((G, Sb), jnp.int32),
+                    jnp.ones((G,), jnp.int32),
+                    jnp.zeros((G,), jnp.uint32),
+                    jnp.ones((G,), jnp.float32),
+                    jnp.zeros((G,), jnp.int32),
+                    jnp.ones((G,), jnp.float32),
+                    jnp.ones((G,), jnp.int32),
+                    jnp.arange(G, dtype=jnp.int32),
+                )
+        # All slots inactive: pure compile + masked no-op writes.
+        self._state, _, _, _ = self._jit_chunk(self.params, self._state)
+        jax.block_until_ready(self._state["last_tok"])
+        logger.info(
+            "engine warmed: %d admission variants + decode chunk",
+            len(self._buckets) * len(sizes),
+        )
+
     # --- scheduler loop -----------------------------------------------------
 
     def _bucket(self, n: int) -> int:
@@ -238,77 +350,140 @@ class InferenceEngine:
                 return b
         return self.ecfg.max_seq_len
 
-    def _admit(self) -> None:
-        while self._free and not self._pending.empty():
+    def _dispatch_admits(self) -> List[Tuple[List[_Request], Any, Any]]:
+        """Admit FIFO prefix runs of same-bucket waiting requests as batched
+        groups. Dispatches device work only — returns un-synced handles."""
+        while True:
             try:
-                req = self._pending.get_nowait()
+                self._waiting.append(self._pending.get_nowait())
             except queue.Empty:
-                return
+                break
+        admits: List[Tuple[List[_Request], Any, Any]] = []
+        while self._free and self._waiting:
+            Sb = self._bucket(len(self._waiting[0].tokens))
+            max_g = min(self._max_admit, len(self._free))
+            group: List[_Request] = []
+            while (
+                len(group) < max_g
+                and self._waiting
+                and self._bucket(len(self._waiting[0].tokens)) == Sb
+            ):
+                group.append(self._waiting.popleft())
             try:
-                self._admit_one(req)
-            except Exception as e:  # bad request must not kill the loop
-                logger.exception("admission failed for request %d", req.rid)
-                slot = req.slot
-                if slot >= 0:
-                    # Reclaim the slot whether or not registration got as
-                    # far as self._slots[slot] = req.
-                    if self._slots[slot] is req:
-                        self._slots[slot] = None
-                        self._active = self._active.at[slot].set(False)
-                        self._active_host[slot] = False
-                    if slot not in self._free:
-                        self._free.append(slot)
-                req.out.put({"error": str(e)})
-                req.out.put(None)
+                admits.append(self._dispatch_admit_group(group, Sb))
+            except Exception as e:  # bad batch must not kill the loop
+                logger.exception(
+                    "admission failed for requests %s",
+                    [r.rid for r in group],
+                )
+                for req in group:
+                    slot = req.slot
+                    if slot >= 0:
+                        if self._slots[slot] is req:
+                            self._slots[slot] = None
+                            self._active_host[slot] = False
+                        if slot not in self._free:
+                            self._free.append(slot)
+                    req.out.put({"error": str(e)})
+                    req.out.put(None)
+        return admits
 
-    def _admit_one(self, req: _Request) -> None:
-        slot = self._free.pop()
-        req.slot = slot
-        Sb = self._bucket(len(req.tokens))
-        toks = np.full((1, Sb), self.cfg.pad_token_id, np.int32)
-        toks[0, : len(req.tokens)] = req.tokens
-        plen = jnp.asarray([len(req.tokens)], jnp.int32)
-        sp = req.params
-        seed = int(sp.seed) & 0xFFFFFFFF  # clamp before jax.random.key
-        # First token keyed by (seed, prompt position) — same seed +
-        # same prompt reproduces the completion regardless of traffic.
-        first, sub_k, sub_v = self._jit_prefill(
+    def _dispatch_admit_group(
+        self, group: List[_Request], Sb: int
+    ) -> Tuple[List[_Request], Any, Any]:
+        """Build host arrays for `group`, dispatch the fused admission.
+
+        G is padded up to a power of two by replicating the last request
+        (identical slot + data, so the duplicate scatter writes are
+        harmless), bounding compile variants to log2(max_admit)+1 per
+        bucket."""
+        G = len(group)
+        Gp = 1
+        while Gp < G:
+            Gp *= 2
+        for req in group:
+            req.slot = self._free.pop()
+        toks = np.full((Gp, Sb), self.cfg.pad_token_id, np.int32)
+        plens = np.empty((Gp,), np.int32)
+        seeds = np.empty((Gp,), np.uint32)
+        temps = np.empty((Gp,), np.float32)
+        top_ks = np.empty((Gp,), np.int32)
+        top_ps = np.empty((Gp,), np.float32)
+        max_news = np.empty((Gp,), np.int32)
+        slots = np.empty((Gp,), np.int32)
+        for i in range(Gp):
+            req = group[min(i, G - 1)]
+            sp = req.params
+            toks[i, : len(req.tokens)] = req.tokens
+            plens[i] = len(req.tokens)
+            seeds[i] = np.uint32(int(sp.seed) & 0xFFFFFFFF)
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+            max_news[i] = sp.max_new_tokens
+            slots[i] = req.slot
+        self._state, first, first_done = self._jit_admit(
             self.params,
+            self._state,
             jnp.asarray(toks),
-            plen,
-            jax.random.fold_in(jax.random.key(seed), len(req.tokens)),
-            jnp.asarray([sp.temperature], jnp.float32),
-            jnp.asarray([sp.top_k], jnp.int32),
-            jnp.asarray([sp.top_p], jnp.float32),
+            jnp.asarray(plens),
+            jnp.asarray(seeds),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            jnp.asarray(max_news),
+            jnp.asarray(slots),
         )
-        self._cache = self._jit_insert(self._cache, sub_k, sub_v, slot)
-        first_tok = int(np.asarray(first)[0])
-        now = time.perf_counter()
-        req.first_token_at = now
-        ttft_ms = 1000.0 * (now - req.submitted_at)
-        with self.stats.lock:
-            self.stats.ttft_sum += ttft_ms / 1000.0
-            self.stats.ttft_count += 1
-            self.stats.tokens_out += 1
-        req.n_generated = 1
-        self._slots[slot] = req
-        req.out.put({"token": first_tok, "ttft_ms": ttft_ms})
-        if (
-            first_tok == self.cfg.eos_token_id
-            or req.params.max_new_tokens <= 1
-            or len(req.tokens) + 1 >= self.ecfg.max_seq_len
-        ):
-            self._finish(slot)
-            return
-        # Arm the slot for decoding.
-        self._last_tok = self._last_tok.at[slot].set(first_tok)
-        self._pos = self._pos.at[slot].set(len(req.tokens))
-        self._active = self._active.at[slot].set(True)
-        self._active_host[slot] = True
-        self._temp = self._temp.at[slot].set(sp.temperature)
-        self._top_k = self._top_k.at[slot].set(sp.top_k)
-        self._top_p = self._top_p.at[slot].set(sp.top_p)
-        self._seeds = self._seeds.at[slot].set(np.uint32(seed))
+        # Register rows now so an error path can fail them cleanly; the
+        # active mirror is armed at boundary processing.
+        for req in group:
+            self._slots[req.slot] = req
+        return group, first, first_done
+
+    def _process_admits(
+        self,
+        admits: List[Tuple[List[_Request], Any, Any]],
+        admit_data: List[Tuple[np.ndarray, np.ndarray]],
+    ) -> None:
+        for (group, _, _), (first_h, done_h) in zip(admits, admit_data):
+            now = time.perf_counter()
+            ttft_total = 0.0
+            for i, req in enumerate(group):
+                slot = req.slot
+                first_tok = int(first_h[i])
+                req.first_token_at = now
+                ttft_ms = 1000.0 * (now - req.submitted_at)
+                ttft_total += ttft_ms
+                req.n_generated = 1
+                req.out.put({"tokens": [first_tok], "ttft_ms": ttft_ms})
+                if bool(done_h[i]):
+                    self._finish(slot)
+                else:
+                    self._active_host[slot] = True
+            with self.stats.lock:
+                self.stats.ttft_sum += ttft_total / 1000.0
+                self.stats.ttft_count += len(group)
+                self.stats.tokens_out += len(group)
+
+    def _process_chunk(self, toks_h, valid_h, active_h) -> None:
+        """toks_h [K, B], valid_h [K, B], active_h [B] — host arrays.
+        `valid` is a True-prefix per column (rows stop and stay stopped
+        within a chunk), so the first n_valid rows are the emitted tokens."""
+        n_valid = valid_h.sum(axis=0)
+        total = 0
+        for slot, req in enumerate(self._slots):
+            if req is None or not self._active_host[slot]:
+                continue
+            n = int(n_valid[slot])
+            if n:
+                req.out.put({"tokens": toks_h[:n, slot].tolist()})
+                req.n_generated += n
+                total += n
+            if not active_h[slot]:
+                self._finish(slot)
+        if total:
+            with self.stats.lock:
+                self.stats.tokens_out += total
 
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]
@@ -316,54 +491,95 @@ class InferenceEngine:
             return
         req.out.put(None)
         self._slots[slot] = None
-        self._active = self._active.at[slot].set(False)
         self._active_host[slot] = False
         self._free.append(slot)
         with self.stats.lock:
             self.stats.completed += 1
 
-    def _loop(self) -> None:
-        while not self._stop.is_set():
-            self._admit()
-            if not self._active_host.any():
-                if self._pending.empty():
-                    time.sleep(self.ecfg.idle_sleep_s)
-                continue
-            try:
-                self._decode_once()
-            except Exception as e:  # fail active requests, keep serving
-                logger.exception("decode iteration failed")
-                for slot, req in enumerate(self._slots):
-                    if req is not None:
-                        req.out.put({"error": str(e)})
-                        self._finish(slot)
-
-    def _decode_once(self) -> None:
-        self._cache, toks, self._pos = self._jit_decode(
-            self.params,
-            self._cache,
-            self._last_tok,
-            self._pos,
-            self._active,
-            self._seeds,
-            self._temp,
-            self._top_k,
-            self._top_p,
-        )
-        self._last_tok = toks
-        toks_host = np.asarray(toks)
-        pos_host = np.asarray(self._pos)
+    def _fail_all(self, err: str) -> None:
+        """Fail every registered request and reset device state — called
+        when a dispatched computation errored (donated buffers are gone)."""
         for slot, req in enumerate(self._slots):
-            if req is None or not self._active_host[slot]:
-                continue
-            t = int(toks_host[slot])
-            req.out.put({"token": t})
-            req.n_generated += 1
-            with self.stats.lock:
-                self.stats.tokens_out += 1
-            if (
-                t == self.cfg.eos_token_id
-                or req.n_generated >= req.params.max_new_tokens
-                or int(pos_host[slot]) >= self.ecfg.max_seq_len - 1
-            ):
+            if req is not None:
+                req.out.put({"error": err})
                 self._finish(slot)
+        self._state = self._fresh_state()
+
+    def _process_boundary(self, admits, chunk_handles) -> None:
+        """Fetch one boundary's device results (one parallel transfer) and
+        run host bookkeeping."""
+        admit_data, chunk_data = jax.device_get(
+            (
+                [(f, d) for _, f, d in admits],
+                chunk_handles,
+            )
+        )
+        self._process_admits(admits, admit_data)
+        if chunk_data is not None:
+            self._process_chunk(*chunk_data)
+
+    def _pipeline_safe(self, have_pending: bool) -> bool:
+        """True when every in-flight row is expected to survive the next
+        decode chunk (by length budget; EOS is unpredictable and merely
+        costs one masked chunk when mispredicted). When False the scheduler
+        syncs first so finished slots are freed and re-admitted without a
+        wasted chunk."""
+        K = max(1, self.ecfg.decode_chunk)
+        lag = K if have_pending else 0
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.params.max_new_tokens - (req.n_generated + lag) <= K:
+                return False
+        return True
+
+    def _loop(self) -> None:
+        # Software-pipelined scheduler: chunk N+1 is dispatched BEFORE
+        # chunk N's results are fetched, so the host fetch (one device
+        # round trip) and queue bookkeeping overlap with device compute.
+        # This is safe because per-row termination is device-side: rows
+        # that finished during chunk N are already frozen (active=False in
+        # the carried state) when chunk N+1 runs — the host merely learns
+        # about it one boundary late. Near row completion the loop drops
+        # to sync mode so finishing slots are freed (and re-admitted)
+        # without paying a wasted masked chunk.
+        pending: Optional[Tuple[list, Any]] = None
+        while not self._stop.is_set():
+            try:
+                admits = self._dispatch_admits()
+                if pending is not None and not self._pipeline_safe(True):
+                    self._process_boundary(*pending)
+                    pending = None
+                    # Freed slots can take waiting requests this boundary.
+                    admits.extend(self._dispatch_admits())
+                if admits or self._active_host.any():
+                    # Chunk consumes the post-admission state; device-side
+                    # `active` is already armed even though _active_host
+                    # lags until _process_admits.
+                    self._state, toks, valid, active_after = self._jit_chunk(
+                        self.params, self._state
+                    )
+                    chunk_handles = (toks, valid, active_after)
+                else:
+                    chunk_handles = None
+                if pending is not None:
+                    self._process_boundary(*pending)
+                pending = (
+                    (admits, chunk_handles)
+                    if (admits or chunk_handles is not None)
+                    else None
+                )
+                if pending is None and not self._active_host.any():
+                    if self._pending.empty():
+                        time.sleep(self.ecfg.idle_sleep_s)
+            except Exception as e:  # fail requests, reset, keep serving
+                logger.exception("engine iteration failed")
+                pending = None
+                self._fail_all(str(e))
+        # Drain the in-flight boundary so stop() doesn't strand requests.
+        if pending is not None:
+            try:
+                self._process_boundary(*pending)
+            except Exception as e:
+                logger.exception("final boundary failed")
+                self._fail_all(str(e))

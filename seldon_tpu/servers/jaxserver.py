@@ -43,15 +43,17 @@ class JAXServer(SeldonComponent):
         self,
         model_uri: Optional[str] = None,
         preset: str = "bench-1b",
-        max_slots: int = 8,
+        max_slots: int = 32,
         max_seq_len: int = 0,
         init_seed: int = 0,
+        warmup: int = 0,
     ):
         self.model_uri = model_uri
         self.preset = preset
         self.max_slots = int(max_slots)
         self.max_seq_len = int(max_seq_len)
         self.init_seed = int(init_seed)
+        self.warmup = int(warmup)
         self._loaded = False
         self._load_lock = threading.Lock()
         self.engine: Optional[InferenceEngine] = None
@@ -110,6 +112,8 @@ class JAXServer(SeldonComponent):
                 ),
                 mesh=mesh,
             )
+            if self.warmup:
+                self.engine.warmup()
             self.engine.start()
             self.params = params
 
@@ -220,13 +224,15 @@ class JAXServer(SeldonComponent):
                 break
             if "error" in item:
                 raise RuntimeError(f"generation failed: {item['error']}")
-            tok = item["token"]
-            if tok == self.cfg.eos_token_id:
+            # Tokens arrive in decode-chunk bursts; emit one stream chunk
+            # per burst (EOS stripped).
+            toks = [t for t in item["tokens"] if t != self.cfg.eos_token_id]
+            if not toks:
                 continue
-            n += 1
+            n += len(toks)
             yield {
-                "text": self.tokenizer.decode([tok]),
-                "token_ids": [tok],
+                "text": self.tokenizer.decode(toks),
+                "token_ids": toks,
                 "ttft_ms": item.get("ttft_ms", 0.0),
                 "total_ms": 1000.0 * (time.perf_counter() - t0),
                 "prompt_tokens": len(ids),

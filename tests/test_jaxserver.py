@@ -243,19 +243,19 @@ def test_engine_bad_request_fails_cleanly(engine):
     """An admission failure must fail that request only (no wedged loop);
     the engine keeps serving afterwards. Also: absurd seeds are clamped,
     not fatal."""
-    real_prefill = engine._jit_prefill
+    real_admit = engine._jit_admit
 
     def boom(*a, **k):
         raise ValueError("injected prefill failure")
 
-    engine._jit_prefill = boom
+    engine._jit_admit = boom
     try:
         with pytest.raises(RuntimeError, match="injected"):
             engine.generate_blocking(
                 [3, 4], SamplingParams(temperature=0.0, max_new_tokens=2)
             )
     finally:
-        engine._jit_prefill = real_prefill
+        engine._jit_admit = real_admit
     # Engine still serves, including a seed far beyond uint32.
     ok = engine.generate_blocking(
         [3, 4], SamplingParams(temperature=1.0, max_new_tokens=2, seed=2**80)
