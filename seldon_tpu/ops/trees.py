@@ -28,6 +28,7 @@ class TreeEnsemble:
     left: np.ndarray  # int32 child index (within tree)
     right: np.ndarray
     value: np.ndarray  # f32 leaf value (0 on internal nodes)
+    missing: np.ndarray  # int32 child for NaN features (xgboost 'missing')
     max_depth: int
     base_score: float = 0.0
 
@@ -53,6 +54,7 @@ def _pad_trees(trees: List[Dict[str, List]], max_depth_cap: int = 64):
         arr("left", 0, np.int32),
         arr("right", 0, np.int32),
         arr("value", 0.0, np.float32),
+        arr("missing", 0, np.int32),
     )
 
 
@@ -76,7 +78,8 @@ def from_xgboost_json(dump: Sequence[str] | str, base_score: float = 0.0
             nid = node["nodeid"]
             if "leaf" in node:
                 nodes[nid] = {"feature": -1, "threshold": 0.0, "left": nid,
-                              "right": nid, "value": float(node["leaf"])}
+                              "right": nid, "missing": nid,
+                              "value": float(node["leaf"])}
                 return
             feat = node["split"]
             fidx = int(feat[1:]) if isinstance(feat, str) and feat.startswith("f") else int(feat)
@@ -85,6 +88,9 @@ def from_xgboost_json(dump: Sequence[str] | str, base_score: float = 0.0
                 "threshold": float(node["split_condition"]),
                 "left": int(node["yes"]),
                 "right": int(node["no"]),
+                # xgboost routes NaN to the learned missing-direction child
+                # (defaults to 'yes' when the dump omits it).
+                "missing": int(node.get("missing", node["yes"])),
                 "value": 0.0,
             }
             for child in node.get("children", []):
@@ -95,7 +101,7 @@ def from_xgboost_json(dump: Sequence[str] | str, base_score: float = 0.0
         ids = sorted(nodes)
         remap = {old: new for new, old in enumerate(ids)}
         tree = {"feature": [], "threshold": [], "left": [], "right": [],
-                "value": []}
+                "value": [], "missing": []}
         for old in ids:
             nd = nodes[old]
             tree["feature"].append(nd["feature"])
@@ -103,10 +109,11 @@ def from_xgboost_json(dump: Sequence[str] | str, base_score: float = 0.0
             tree["left"].append(remap[nd["left"]])
             tree["right"].append(remap[nd["right"]])
             tree["value"].append(nd["value"])
+            tree["missing"].append(remap[nd["missing"]])
         trees.append(tree)
 
-    f, t, l, r, v = _pad_trees(trees)
-    return TreeEnsemble(f, t, l, r, v, max_depth=max_depth,
+    f, t, l, r, v, m = _pad_trees(trees)
+    return TreeEnsemble(f, t, l, r, v, m, max_depth=max_depth,
                         base_score=base_score)
 
 
@@ -117,6 +124,7 @@ def predict_margin(ensemble: TreeEnsemble, X: jnp.ndarray) -> jnp.ndarray:
     left = jnp.asarray(ensemble.left)
     right = jnp.asarray(ensemble.right)
     value = jnp.asarray(ensemble.value)
+    missing = jnp.asarray(ensemble.missing)
     B = X.shape[0]
     T = ensemble.n_trees
     node = jnp.zeros((B, T), jnp.int32)
@@ -129,6 +137,9 @@ def predict_margin(ensemble: TreeEnsemble, X: jnp.ndarray) -> jnp.ndarray:
         x = jnp.take_along_axis(X, jnp.maximum(feat, 0), axis=1)
         go_left = x < thr
         nxt = jnp.where(go_left, left[tree_idx, node], right[tree_idx, node])
+        # NaN features take the learned missing-direction child (x < thr is
+        # False for NaN, which would silently route 'no'/right otherwise).
+        nxt = jnp.where(jnp.isnan(x), missing[tree_idx, node], nxt)
         return jnp.where(is_leaf, node, nxt)
 
     node = jax.lax.fori_loop(0, ensemble.max_depth, step, node)

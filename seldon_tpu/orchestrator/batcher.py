@@ -141,10 +141,10 @@ class MicroBatcher:
         kind = q[0].kind
         req = payloads.build_message(fused, kind=kind)
         req.meta.puid = q[0].puid or "fused"
-        # Preserve every fused request's tags (later requests win ties).
-        for p in q:
-            for k, v in p.msg.meta.tags.items():
-                req.meta.tags[k].CopyFrom(v)
+        # Request-originated tags are NOT unioned into the fused request:
+        # they would come back in resp.meta and leak one request's metadata
+        # into every co-batched requester's split response. The unit sees
+        # only batch_index; split responses carry only unit-produced tags.
         bi = pb.BatchIndex(
             puids=[p.puid for p in q],
             row_counts=[p.arr.shape[0] for p in q],
