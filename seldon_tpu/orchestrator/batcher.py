@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 
 class _Pending:
-    __slots__ = ("msg", "arr", "future", "puid", "kind")
+    __slots__ = ("msg", "arr", "future", "puid", "kind", "tag_sig")
 
     def __init__(self, msg, arr, future, puid, kind):
         self.msg = msg
@@ -34,6 +34,13 @@ class _Pending:
         self.future = future
         self.puid = puid
         self.kind = kind
+        # Canonical request-tag fingerprint: only requests with IDENTICAL
+        # tags co-batch, so the fused request can carry those tags and the
+        # unit sees exactly what it would on the direct (unbatched) path.
+        self.tag_sig = tuple(sorted(
+            (k, v.SerializeToString(deterministic=True))
+            for k, v in msg.meta.tags.items()
+        ))
 
 
 class MicroBatcher:
@@ -55,6 +62,10 @@ class MicroBatcher:
     @staticmethod
     def _batchable(msg: pb.SeldonMessage) -> Optional[np.ndarray]:
         if msg.WhichOneof("data_oneof") != "data":
+            return None
+        # A request already carrying a batch_index tag (nested/upstream
+        # batching) must not fuse: the framing key would collide.
+        if "batch_index" in msg.meta.tags:
             return None
         arr = payloads.data_to_array(msg.data)
         # ndim >= 2 required: a 1-D array is one sample's feature vector,
@@ -86,6 +97,7 @@ class MicroBatcher:
             if q and (
                 q[0].arr.shape[1:] != arr.shape[1:]
                 or q[0].arr.dtype != arr.dtype
+                or q[0].tag_sig != pend.tag_sig
             ):
                 # Shape/dtype mismatch with the open batch: flush it first.
                 to_exec.append(self._take(unit.name))
@@ -141,10 +153,12 @@ class MicroBatcher:
         kind = q[0].kind
         req = payloads.build_message(fused, kind=kind)
         req.meta.puid = q[0].puid or "fused"
-        # Request-originated tags are NOT unioned into the fused request:
-        # they would come back in resp.meta and leak one request's metadata
-        # into every co-batched requester's split response. The unit sees
-        # only batch_index; split responses carry only unit-produced tags.
+        # Co-batched requests are guaranteed (by tag_sig grouping) to carry
+        # IDENTICAL tags, so forwarding q[0]'s tags gives the unit the same
+        # view as the direct path, and nothing cross-request can leak: any
+        # tag echoed back belongs to every requester in the batch equally.
+        for k, v in q[0].msg.meta.tags.items():
+            req.meta.tags[k].CopyFrom(v)
         bi = pb.BatchIndex(
             puids=[p.puid for p in q],
             row_counts=[p.arr.shape[0] for p in q],
@@ -161,13 +175,19 @@ class MicroBatcher:
                     f"!= request rows {fused.shape[0]}"
                 )
             names = list(resp.data.names) if resp.HasField("data") else None
+            # Non-numeric unit output (e.g. string class labels) can't ride
+            # the dense/tensor encodings — fall back to ndarray for all
+            # splits, matching construct_response's direct-path gate (kind
+            # in "USO"; note bfloat16 has dtype.kind 'V' and IS numeric).
+            numeric = out.dtype.kind not in "USO"
             row = 0
             for p in q:
                 n = p.arr.shape[0]
                 # Each request's reply uses ITS OWN payload kind, so the
                 # wire encoding never depends on co-batched traffic.
                 sub = payloads.build_message(
-                    out[row: row + n], names=names, kind=p.kind,
+                    out[row: row + n], names=names,
+                    kind=p.kind if numeric else "ndarray",
                 )
                 sub.meta.CopyFrom(resp.meta)
                 sub.meta.puid = p.puid

@@ -23,6 +23,7 @@ class SKLearnServer:
         self.model = None
         self._jax_params: Optional[Dict[str, np.ndarray]] = None
         self._predict_jit = None
+        self._kind = "logistic"
 
     def load(self) -> None:
         local = download(self.model_uri)
@@ -53,6 +54,7 @@ class SKLearnServer:
         coef = jnp.asarray(self._jax_params["coef"], jnp.float32)
         intercept = jnp.asarray(self._jax_params["intercept"], jnp.float32)
         kind = str(self._jax_params.get("kind", np.array("logistic")))
+        self._kind = kind
 
         @jax.jit
         def fwd(X):
@@ -74,7 +76,16 @@ class SKLearnServer:
         if self._predict_jit is not None:
             out = np.asarray(self._predict_jit(X))
             if self.method == "predict":
-                return np.argmax(out, axis=-1)
+                if "logistic" not in self._kind:
+                    # Regressor: sklearn's model.predict() returns the raw
+                    # outputs, shape (n,) for single-target models.
+                    return out[:, 0] if out.ndim == 2 and out.shape[1] == 1 else out
+                idx = np.argmax(out, axis=-1)
+                # Mirror sklearn's model.predict(): return class LABELS, not
+                # argmax indices (labels may be strings / non-contiguous).
+                if "classes" in self._jax_params:
+                    return np.asarray(self._jax_params["classes"])[idx]
+                return idx
             return out
         if self.method == "predict_proba" and hasattr(self.model, "predict_proba"):
             return self.model.predict_proba(X)
@@ -102,6 +113,12 @@ def export_linear_model(path: str, coef, intercept, classes=None,
         "kind": np.array(kind),
     }
     if classes is not None:
-        arrays["classes"] = np.asarray([str(c) for c in classes])
+        # Preserve the original label dtype (int/float/str): predict() maps
+        # argmax indices through this array and must return what sklearn's
+        # model.predict() would — integer labels stay integers. Object-dtype
+        # arrays (sklearn's usual dtype for string labels) can't round-trip
+        # through allow_pickle=False, so coerce those to fixed-width str.
+        cls = np.asarray(classes)
+        arrays["classes"] = cls.astype(str) if cls.dtype == object else cls
     np.savez(out, **arrays)
     return out

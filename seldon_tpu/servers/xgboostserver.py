@@ -37,6 +37,11 @@ class XGBoostServer:
                 dump = doc["trees"]
                 self.objective = self.objective or doc.get("objective", "reg")
                 base = float(doc.get("base_score", 0.0))
+                # xgboost stores base_score for logistic objectives in
+                # PROBABILITY space (default 0.5 == margin 0); traversal sums
+                # margins, so convert to margin space via logit.
+                if "logistic" in (self.objective or "") and 0.0 < base < 1.0:
+                    base = float(np.log(base / (1.0 - base)))
             else:
                 dump = doc
                 base = 0.0

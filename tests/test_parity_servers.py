@@ -78,6 +78,22 @@ def test_xgboost_server_json(tmp_path):
     assert srv.tags()["backend"] == "jax-trees"
 
 
+def test_xgboost_server_logistic_base_score(tmp_path):
+    # xgboost's stored base_score for binary:logistic is in PROBABILITY
+    # space: 0.5 must contribute margin logit(0.5)=0, not +0.5.
+    model_dir = tmp_path / "xgb"
+    model_dir.mkdir()
+    (model_dir / "model.json").write_text(
+        json.dumps({"trees": [TREE0, TREE1], "objective": "binary:logistic",
+                    "base_score": 0.5})
+    )
+    srv = XGBoostServer(model_uri=str(model_dir))
+    srv.load()
+    out = srv.predict(np.array([[0.0, 0.0]], np.float32), [])
+    # margins sum to 1.5; sigmoid(1.5 + logit(0.5)) == sigmoid(1.5)
+    np.testing.assert_allclose(out, [1.0 / (1.0 + np.exp(-1.5))], rtol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # SKLearnServer on the jax path
 # ---------------------------------------------------------------------------
@@ -100,7 +116,8 @@ def test_sklearn_server_npz_logistic(tmp_path):
     srv2 = SKLearnServer(model_uri=str(tmp_path), method="predict")
     srv2.load()
     labels = srv2.predict(np.array([[0.0, 5.0]], np.float32), [])
-    assert labels[0] == 1
+    # sklearn's model.predict() returns class LABELS, not argmax indices.
+    assert labels[0] == "b"
 
 
 def test_sklearn_server_binary_sigmoid(tmp_path):

@@ -132,3 +132,90 @@ class TestGenerateStream:
             assert len(chunks) == 1 and chunks[0].text == "single"
         finally:
             server.stop(0)
+
+
+class TestRound2ReviewFindings:
+    """Round-2 review: parity-server output semantics + batcher splits."""
+
+    def test_sklearn_linear_regressor_predict_returns_values(self, tmp_path):
+        from seldon_tpu.servers.sklearnserver import (
+            SKLearnServer, export_linear_model,
+        )
+
+        export_linear_model(str(tmp_path), np.array([[2.0, 1.0]]),
+                            np.array([0.5]), kind="linear")
+        srv = SKLearnServer(model_uri=str(tmp_path), method="predict")
+        srv.load()
+        out = srv.predict(np.array([[1.0, 1.0], [2.0, 0.0]], np.float32), [])
+        # Regression values (shape (n,)), NOT argmax indices.
+        np.testing.assert_allclose(out, [3.5, 4.5], rtol=1e-6)
+
+    def test_xgboost_reg_logistic_base_score_gate(self, tmp_path):
+        import json as _json
+
+        from seldon_tpu.servers.xgboostserver import XGBoostServer
+
+        tree = {"nodeid": 0, "leaf": 1.5}
+        (tmp_path / "model.json").write_text(_json.dumps(
+            {"trees": [tree], "objective": "reg:logistic", "base_score": 0.5}
+        ))
+        srv = XGBoostServer(model_uri=str(tmp_path))
+        srv.load()
+        out = srv.predict(np.array([[0.0]], np.float32), [])
+        # logit(0.5)=0 margin; sigmoid(1.5) — the conversion gate must match
+        # the sigmoid gate ('logistic', not 'binary').
+        np.testing.assert_allclose(out, [1 / (1 + np.exp(-1.5))], rtol=1e-6)
+
+    def test_batcher_string_output_split(self):
+        """Co-batched requests to a unit returning string labels must split
+        via the ndarray fallback, not crash on dense re-encode."""
+        import asyncio
+
+        from seldon_tpu.orchestrator.batcher import MicroBatcher
+        from seldon_tpu.orchestrator.spec import PredictiveUnit
+
+        class FakeClient:
+            async def call(self, unit, method, msg):
+                arr = payloads.get_data_from_message(msg)
+                labels = np.array([["x"] if r[0] < 0 else ["y"] for r in arr])
+                resp = payloads.build_message(labels, kind="ndarray")
+                resp.meta.CopyFrom(msg.meta)
+                return resp
+
+        unit = PredictiveUnit(name="m", type="MODEL")
+        b = MicroBatcher(max_batch_size=64, window_ms=5.0)
+
+        async def run():
+            m1 = payloads.build_message(np.array([[-1.0]], np.float32))
+            m2 = payloads.build_message(np.array([[1.0]], np.float32))
+            return await asyncio.gather(
+                b.call(unit, m1, FakeClient()), b.call(unit, m2, FakeClient())
+            )
+
+        r1, r2 = asyncio.run(run())
+        assert payloads.get_data_from_message(r1).tolist() == [["x"]]
+        assert payloads.get_data_from_message(r2).tolist() == [["y"]]
+        assert b.stats["fused_calls"] == 1
+
+    def test_batcher_nested_batch_index_goes_direct(self):
+        import asyncio
+
+        from seldon_tpu.orchestrator.batcher import MicroBatcher
+        from seldon_tpu.orchestrator.spec import PredictiveUnit
+
+        calls = []
+
+        class FakeClient:
+            async def call(self, unit, method, msg):
+                calls.append(msg)
+                resp = pb.SeldonMessage()
+                resp.CopyFrom(msg)
+                return resp
+
+        unit = PredictiveUnit(name="m", type="MODEL")
+        b = MicroBatcher(max_batch_size=64, window_ms=5.0)
+        m = payloads.build_message(np.array([[1.0]], np.float32))
+        m.meta.tags["batch_index"].string_value = "deadbeef"
+        out = asyncio.run(b.call(unit, m, FakeClient()))
+        assert b.stats["direct_calls"] == 1 and b.stats["fused_calls"] == 0
+        assert out.meta.tags["batch_index"].string_value == "deadbeef"
