@@ -56,11 +56,17 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
 MOE_AUX_WEIGHT = 0.01
 
 
-def loss_fn(params, tokens, loss_mask, cfg: ModelConfig, act_spec=None):
+def loss_fn(params, tokens, loss_mask, cfg: ModelConfig, act_spec=None,
+            forward_fn=None):
     """Next-token CE (+ router load-balance aux for MoE configs).
-    tokens [B,S]; loss_mask [B,S] (0 on pad/prompt)."""
-    logits, aux = transformer.forward(params, tokens, cfg, act_spec=act_spec,
-                                      remat=True, return_aux=True)
+    tokens [B,S]; loss_mask [B,S] (0 on pad/prompt).
+    forward_fn overrides the dense forward (pipeline-parallel path)."""
+    if forward_fn is not None:
+        logits, aux = forward_fn(params, tokens)
+    else:
+        logits, aux = transformer.forward(params, tokens, cfg,
+                                          act_spec=act_spec,
+                                          remat=True, return_aux=True)
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
@@ -96,15 +102,32 @@ def _shardings_like(shape_tree, params_ns_tree, repl: NamedSharding):
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig, optimizer,
-                            seq_sharded: bool = True):
+                            seq_sharded: bool = True,
+                            n_microbatches: int = 4):
     """Returns (init_fn, step_fn).
 
     init_fn(key) -> TrainState, materialized sharded on `mesh`.
     step_fn(state, tokens, loss_mask) -> (state, metrics); donates state.
+
+    If the mesh has a pp axis > 1, the layer stack is pipeline-parallel:
+    weights shard their layer axis over 'pp' and the forward runs the
+    GPipe microbatch schedule (parallel/pipeline.py); dp/sp/tp/ep compose
+    unchanged.
     """
     cfg = cfg.validate()
+    pp = mesh.shape.get("pp", 1)
+    forward_fn = None
+    if pp > 1:
+        from seldon_tpu.parallel import pipeline
+
+        forward_fn = pipeline.make_pipeline_forward(
+            mesh, cfg, n_microbatches=n_microbatches, remat=True
+        )
+        param_specs = pipeline.pp_param_pspecs(cfg)
+    else:
+        param_specs = shd.param_pspecs(cfg)
     act_spec = NamedSharding(mesh, shd.activation_pspec(seq_sharded))
-    params_ns = shd.named_shardings(mesh, shd.param_pspecs(cfg))
+    params_ns = shd.named_shardings(mesh, param_specs)
     repl = NamedSharding(mesh, P())
     batch_ns = NamedSharding(mesh, shd.batch_pspec(seq_sharded))
 
@@ -121,7 +144,8 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig, optimizer,
 
     def _step(state: TrainState, tokens, loss_mask):
         loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, tokens, loss_mask, cfg, act_spec
+            state.params, tokens, loss_mask, cfg,
+            None if forward_fn is not None else act_spec, forward_fn
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params

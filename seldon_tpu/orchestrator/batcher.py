@@ -57,7 +57,8 @@ class MicroBatcher:
         self._queues: Dict[str, List[_Pending]] = {}
         self._timers: Dict[str, asyncio.TimerHandle] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
-        self.stats = {"fused_calls": 0, "direct_calls": 0, "batched_requests": 0}
+        self.stats = {"fused_calls": 0, "direct_calls": 0,
+                      "batched_requests": 0, "tag_flushes": 0}
 
     @staticmethod
     def _batchable(msg: pb.SeldonMessage) -> Optional[np.ndarray]:
@@ -99,7 +100,12 @@ class MicroBatcher:
                 or q[0].arr.dtype != arr.dtype
                 or q[0].tag_sig != pend.tag_sig
             ):
-                # Shape/dtype mismatch with the open batch: flush it first.
+                # Shape/dtype/tag mismatch with the open batch: flush it
+                # first. tag_flushes makes tag-driven batching collapse
+                # observable (a per-request-unique upstream tag silently
+                # degrades every leaf call to batch-1 otherwise).
+                if q[0].tag_sig != pend.tag_sig:
+                    self.stats["tag_flushes"] += 1
                 to_exec.append(self._take(unit.name))
                 q = self._queues.setdefault(unit.name, [])
             q.append(pend)

@@ -17,7 +17,7 @@ from typing import Dict, List, Optional
 import grpc
 import grpc.aio
 
-from seldon_tpu.core import payloads
+from seldon_tpu.core import payloads, tracing
 from seldon_tpu.orchestrator.spec import Endpoint, EndpointType, PredictiveUnit
 from seldon_tpu.proto import prediction_grpc
 from seldon_tpu.proto import prediction_pb2 as pb
@@ -145,7 +145,10 @@ class InternalClient:
         ch = self._channel(ep)
         service, rpc_name = _GRPC_METHODS[method]
         stub = prediction_grpc.STUBS[service](ch)
-        return await getattr(stub, rpc_name)(request, timeout=self.timeout_s)
+        metadata = tuple(tracing.inject_current({}).items()) or None
+        return await getattr(stub, rpc_name)(
+            request, timeout=self.timeout_s, metadata=metadata
+        )
 
     async def _call_rest(self, ep: Endpoint, method: str, request, response_cls):
         session = await self._http_session()
@@ -153,7 +156,9 @@ class InternalClient:
         async with session.post(
             url,
             data=request.SerializeToString(),
-            headers={"Content-Type": PROTO_CONTENT_TYPE},
+            headers=tracing.inject_current(
+                {"Content-Type": PROTO_CONTENT_TYPE}
+            ),
             timeout=self.timeout_s,
         ) as resp:
             body = await resp.read()

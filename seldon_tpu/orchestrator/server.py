@@ -16,10 +16,11 @@ import grpc
 import grpc.aio
 from aiohttp import web
 
-from seldon_tpu.core import payloads
+from seldon_tpu.core import payloads, tracing
 from seldon_tpu.core.http import PROTO_CONTENT_TYPE, parse_message, reply
 from seldon_tpu.orchestrator.batcher import MicroBatcher
 from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
+from seldon_tpu.orchestrator.reqlogger import RequestLogger
 from seldon_tpu.orchestrator.spec import (
     HARDCODED_IMPLEMENTATIONS,
     PredictorSpec,
@@ -80,6 +81,7 @@ class EngineServer:
         self.http_port = http_port
         self.grpc_port = grpc_port
         self.metrics = metrics or get_default_metrics()
+        self.reqlogger = RequestLogger(predictor=self.spec.name)
         self.batcher = MicroBatcher() if enable_batching else None
         self.engine = PredictorEngine(
             self.spec,
@@ -111,7 +113,9 @@ class EngineServer:
             except Exception as e:
                 return web.json_response({"error": str(e)}, status=400)
             try:
-                out = await self.engine.predict(msg)
+                out = await self.engine.predict(
+                    msg, trace_parent=tracing.Tracer.extract(request.headers)
+                )
             except UnitCallError as e:
                 return web.json_response(
                     {"status": {"status": 1, "info": str(e), "code": -1,
@@ -120,6 +124,7 @@ class EngineServer:
                 )
             self.metrics.observe("predictions", "rest",
                                  time.perf_counter() - t0, out)
+            self.reqlogger.log_pair(msg, out, out.meta.puid)
             return reply(out, enc)
 
         async def feedback(request: web.Request) -> web.Response:
@@ -182,13 +187,19 @@ class EngineServer:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
             t0 = time.perf_counter()
             try:
-                out = await self.outer.engine.predict(request)
+                out = await self.outer.engine.predict(
+                    request,
+                    trace_parent=tracing.Tracer.extract(
+                        context.invocation_metadata()
+                    ),
+                )
             except UnitCallError as e:
                 await context.abort(grpc.StatusCode.INTERNAL, str(e))
                 return
             self.outer.metrics.observe(
                 "predictions", "grpc", time.perf_counter() - t0, out
             )
+            self.outer.reqlogger.log_pair(request, out, out.meta.puid)
             return out
 
         async def SendFeedback(self, request, context):
@@ -225,6 +236,7 @@ class EngineServer:
             await self._grpc_server.stop(grace=1.0)
         if self._runner is not None:
             await self._runner.cleanup()
+        await self.reqlogger.close()
         await self.engine.close()
 
 

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional
 
 from google.protobuf import json_format
 
-from seldon_tpu.core import payloads
+from seldon_tpu.core import payloads, tracing
 from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
 from seldon_tpu.orchestrator.spec import (
     HARDCODED_IMPLEMENTATIONS,
@@ -90,11 +90,13 @@ class PredictorEngine:
         client: Optional[InternalClient] = None,
         batcher=None,
         metrics_hook=None,
+        tracer: Optional[tracing.Tracer] = None,
     ):
         self.spec = spec
         self.client = client or InternalClient()
         self.batcher = batcher
         self.metrics_hook = metrics_hook  # callable(metric: pb.Metric, unit)
+        self.tracer = tracer or tracing.get_tracer("engine")
         self._hardcoded = {
             u.name: make_hardcoded(u.implementation, u.parameters)
             for u in spec.graph.walk()
@@ -103,13 +105,20 @@ class PredictorEngine:
 
     # --- forward path -------------------------------------------------------
 
-    async def predict(self, request: pb.SeldonMessage) -> pb.SeldonMessage:
+    async def predict(
+        self,
+        request: pb.SeldonMessage,
+        trace_parent: Optional[tracing.SpanContext] = None,
+    ) -> pb.SeldonMessage:
         puid = request.meta.puid or make_puid()
         ctx = _RequestCtx(puid)
         msg = pb.SeldonMessage()
         msg.CopyFrom(request)
         msg.meta.puid = puid
-        out = await self._get_output(msg, self.spec.graph, ctx)
+        with self.tracer.span(
+            "engine.predict", parent=trace_parent, attributes={"puid": puid}
+        ):
+            out = await self._get_output(msg, self.spec.graph, ctx)
         resp = pb.SeldonMessage()
         resp.CopyFrom(out)
         resp.meta.Clear()
@@ -121,6 +130,14 @@ class PredictorEngine:
     ) -> pb.SeldonMessage:
         ctx.request_path[unit.name] = unit.image or unit.name
         hard = self._hardcoded.get(unit.name)
+        with self.tracer.span(
+            f"unit.{unit.name}", attributes={"unit_type": str(unit.type)}
+        ):
+            return await self._walk_unit(msg, unit, hard, ctx)
+
+    async def _walk_unit(
+        self, msg: pb.SeldonMessage, unit: PredictiveUnit, hard, ctx
+    ) -> pb.SeldonMessage:
 
         # (2) transformInput / predict
         transformed = await self._transform_input(msg, unit, hard, ctx)

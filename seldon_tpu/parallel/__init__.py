@@ -10,6 +10,7 @@ ICI instead of DCN.
 """
 
 from seldon_tpu.parallel.mesh import MeshPlan, make_mesh, local_mesh
+from seldon_tpu.parallel.pipeline import make_pipeline_forward, pp_param_pspecs
 from seldon_tpu.parallel.sharding import (
     param_pspecs,
     cache_pspec,
@@ -23,6 +24,8 @@ __all__ = [
     "MeshPlan",
     "make_mesh",
     "local_mesh",
+    "make_pipeline_forward",
+    "pp_param_pspecs",
     "param_pspecs",
     "cache_pspec",
     "batch_pspec",

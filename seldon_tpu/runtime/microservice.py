@@ -151,9 +151,19 @@ def main(argv=None):
     parser.add_argument("--grpc-port", type=int, default=0)
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--tracing",
+        type=lambda v: v.lower() in ("1", "true"),
+        default=os.environ.get("TRACING", "0").lower() in ("1", "true"),
+        help="emit distributed-trace spans (reference: microservice.py"
+             ":115-150 Jaeger gate); sink selected by TRACING_FILE",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=args.log_level)
+    # Wrapper tracers read this env at build time (core/tracing.py);
+    # an explicit --tracing 0 must win over an inherited TRACING=1 env.
+    os.environ["TRACING"] = "1" if args.tracing else "0"
     api_types = [t.strip().upper() for t in args.api_type.split(",") if t.strip()]
     parameters = parse_parameters(args.parameters)
     user_obj = build_user_object(args.interface_name, parameters)

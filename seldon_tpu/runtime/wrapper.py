@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import json
 import logging
 import time
@@ -27,7 +28,7 @@ from typing import Any, Optional
 import grpc
 from aiohttp import web
 
-from seldon_tpu.core import http, payloads
+from seldon_tpu.core import http, payloads, tracing
 from seldon_tpu.core.http import PROTO_CONTENT_TYPE
 from seldon_tpu.proto import prediction_pb2 as pb
 from seldon_tpu.proto import prediction_grpc
@@ -86,10 +87,12 @@ def build_rest_app(
 ) -> web.Application:
     executor = executor or concurrent.futures.ThreadPoolExecutor(max_workers=8)
     metrics = metrics or get_default_metrics()
+    tracer = tracing.get_tracer(_unit_name())
     app = web.Application(client_max_size=1024**3)
     app["user_obj"] = user_obj
     app["executor"] = executor
     app["metrics"] = metrics
+    app["tracer"] = tracer
 
     async def _parse_request(request: web.Request, req_cls):
         try:
@@ -111,9 +114,17 @@ def build_rest_app(
                 return web.json_response(err.to_dict(), status=400)
             loop = asyncio.get_running_loop()
             try:
-                resp = await loop.run_in_executor(
-                    request.app["executor"], fn, request.app["user_obj"], msg
-                )
+                with tracer.span(
+                    f"unit.{method_name}",
+                    parent=tracing.Tracer.extract(request.headers),
+                ):
+                    # copy_context: the user fn runs on an executor thread;
+                    # carry the span over so model-side spans keep nesting.
+                    ctx = contextvars.copy_context()
+                    resp = await loop.run_in_executor(
+                        request.app["executor"],
+                        lambda: ctx.run(fn, request.app["user_obj"], msg),
+                    )
             except SeldonMicroserviceException as e:
                 return web.json_response(e.to_dict(), status=e.status_code)
             except Exception as e:
@@ -214,11 +225,16 @@ class _UnitServicer:
     def __init__(self, user_obj: Any, metrics: Optional[ServerMetrics] = None):
         self._user = user_obj
         self._metrics = metrics or get_default_metrics()
+        self._tracer = tracing.get_tracer(_unit_name())
 
     def _run(self, name: str, fn, request, context):
         t0 = time.perf_counter()
+        parent = tracing.Tracer.extract(
+            context.invocation_metadata() if context is not None else None
+        )
         try:
-            resp = fn(self._user, request)
+            with self._tracer.span(f"unit.{name}", parent=parent):
+                resp = fn(self._user, request)
         except Exception as e:  # pragma: no cover - error path
             logger.exception("grpc %s failed", name)
             context.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -27,6 +27,7 @@ from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from seldon_tpu.core import tracing
 from seldon_tpu.models.config import ModelConfig, get_config
 from seldon_tpu.models.sampling import SamplingParams
 from seldon_tpu.runtime.user_model import SeldonComponent
@@ -58,6 +59,7 @@ class JAXServer(SeldonComponent):
         self._load_lock = threading.Lock()
         self.engine: Optional[InferenceEngine] = None
         self.cfg: Optional[ModelConfig] = None
+        self._tracer = tracing.get_tracer("jaxserver")
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -199,10 +201,18 @@ class JAXServer(SeldonComponent):
         self._ensure_loaded()
         t0 = time.perf_counter()
         ids = self._prompt_ids(request)
-        result = self.engine.generate_blocking(ids, self._to_sampling(request))
-        toks = result["token_ids"]
-        if toks and toks[-1] == self.cfg.eos_token_id:
-            toks = toks[:-1]
+        with self._tracer.span(
+            "jaxserver.generate", attributes={"prompt_tokens": len(ids)}
+        ) as span:
+            result = self.engine.generate_blocking(
+                ids, self._to_sampling(request)
+            )
+            toks = result["token_ids"]
+            if toks and toks[-1] == self.cfg.eos_token_id:
+                toks = toks[:-1]
+            # ttft splits the span into its prefill/decode phases.
+            span.set_attribute("prefill_ms", result["ttft_ms"] or 0.0)
+            span.set_attribute("completion_tokens", len(toks))
         return {
             "text": self.tokenizer.decode(toks),
             "token_ids": toks,

@@ -1,0 +1,107 @@
+"""Pipeline parallelism ('pp'): GPipe microbatch schedule over shard_map.
+
+Runs on the virtual 8-device CPU mesh (conftest). Checks exactness of the
+pipelined forward against the plain forward, gradient flow, composition
+with dp/tp, and the pp train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_tpu.models import get_config, init_params, forward
+from seldon_tpu.models.train import make_optimizer, make_sharded_train_step
+from seldon_tpu.parallel import MeshPlan, make_mesh, sharding as shd
+from seldon_tpu.parallel.pipeline import make_pipeline_forward, pp_param_pspecs
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    return cfg, params, tokens
+
+
+def test_pipeline_forward_matches_plain(tiny_setup):
+    cfg, params, tokens = tiny_setup
+    mesh = make_mesh(MeshPlan(dp=2, pp=2, tp=2))
+    sharded = shd.shard_tree(params, pp_param_pspecs(cfg), mesh)
+    fwd = make_pipeline_forward(mesh, cfg, n_microbatches=2)
+    out, aux = jax.jit(fwd)(sharded, tokens)
+    ref = forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2
+    )
+    assert aux["moe_lb_loss"].shape == ()
+
+
+def test_pipeline_forward_microbatch_counts(tiny_setup):
+    cfg, params, tokens = tiny_setup
+    mesh = make_mesh(MeshPlan(pp=2))
+    sharded = shd.shard_tree(params, pp_param_pspecs(cfg), mesh)
+    ref = forward(params, tokens, cfg)
+    for m in (1, 4):
+        fwd = make_pipeline_forward(mesh, cfg, n_microbatches=m)
+        out, _ = jax.jit(fwd)(sharded, tokens)
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2
+        )
+
+
+def test_pipeline_grads_match_plain(tiny_setup):
+    cfg, params, tokens = tiny_setup
+    mesh = make_mesh(MeshPlan(pp=2))
+    sharded = shd.shard_tree(params, pp_param_pspecs(cfg), mesh)
+    fwd = make_pipeline_forward(mesh, cfg, n_microbatches=2)
+
+    def pp_loss(p):
+        logits, _ = fwd(p, tokens)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    def plain_loss(p):
+        logits = forward(p, tokens, cfg)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    g_pp = jax.jit(jax.grad(pp_loss))(sharded)
+    g_ref = jax.grad(plain_loss)(params)
+    # Spot-check one early-layer and one late-layer leaf so both pipeline
+    # stages' backward paths are covered.
+    for key in ("wq", "w_down"):
+        np.testing.assert_allclose(
+            np.asarray(g_ref["blocks"][key], np.float32),
+            np.asarray(g_pp["blocks"][key], np.float32),
+            rtol=5e-2, atol=5e-3,
+        )
+
+
+def test_pp_train_step_runs_and_learns(tiny_setup):
+    cfg, _, _ = tiny_setup
+    mesh = make_mesh(MeshPlan(dp=2, pp=2, tp=2))
+    optimizer = make_optimizer(total_steps=10)
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, cfg, optimizer, seq_sharded=False, n_microbatches=2
+    )
+    state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    mask = jnp.ones((4, 16), jnp.float32)
+    losses = []
+    for _ in range(3):
+        state, metrics = step_fn(state, tokens, mask)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # same batch every step: must descend
+    # Layer axis is genuinely sharded over pp.
+    wq_shard = state.params["blocks"]["wq"].sharding
+    assert "pp" in wq_shard.spec[0] if isinstance(wq_shard.spec[0], tuple) \
+        else wq_shard.spec[0] == "pp"
+
+
+def test_pipeline_rejects_indivisible():
+    import dataclasses
+
+    cfg = get_config("tiny")
+    mesh = make_mesh(MeshPlan(pp=2))
+    bad = dataclasses.replace(cfg, n_layers=3)
+    with pytest.raises(ValueError):
+        make_pipeline_forward(mesh, bad, n_microbatches=2)

@@ -1,0 +1,131 @@
+"""Request/response logging: CloudEvents pairs engine -> sink.
+
+Reference: PredictionService.java:169-203 (CE POST to
+SELDON_MESSAGE_LOGGING_SERVICE) + seldon-request-logger/app/app.py
+(flattening sink). Tested over a REAL aiohttp sink socket."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from seldon_tpu.core import payloads
+from seldon_tpu.orchestrator.reqlogger import (
+    CE_TYPE_REQUEST, CE_TYPE_RESPONSE, RequestLogger, build_sink_app, _flatten,
+)
+
+
+# ---------------------------------------------------------------------------
+# Flattener (sink side)
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_ndarray_rows_with_names():
+    body = {"data": {"names": ["a", "b"], "ndarray": [[1, 2], [3, 4]]}}
+    docs = _flatten(body, CE_TYPE_REQUEST, "p1", {"Ce-Deploymentname": "dep"})
+    assert len(docs) == 2
+    assert docs[0] == {
+        "ce_type": CE_TYPE_REQUEST, "request_id": "p1", "deployment": "dep",
+        "predictor": "", "kind": "request", "batch_index": 0, "a": 1, "b": 2,
+    }
+    assert docs[1]["a"] == 3 and docs[1]["batch_index"] == 1
+
+
+def test_flatten_tensor_and_fallbacks():
+    body = {"data": {"tensor": {"shape": [2, 2], "values": [1, 2, 3, 4]}}}
+    docs = _flatten(body, CE_TYPE_RESPONSE, "p", {})
+    assert [d["row"] for d in docs] == [[1, 2], [3, 4]]
+    assert docs[0]["kind"] == "response"
+    # strData passthrough
+    docs = _flatten({"strData": "hello"}, CE_TYPE_REQUEST, "p", {})
+    assert docs[0]["payload"] == {"strData": "hello"}
+
+
+# ---------------------------------------------------------------------------
+# Shipper -> sink over a real socket
+# ---------------------------------------------------------------------------
+
+
+async def _start_sink(store):
+    app = build_sink_app(store=store)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/"
+
+
+def test_engine_pair_reaches_sink_and_flattens():
+    async def run():
+        store = []
+        runner, url = await _start_sink(store)
+        rl = RequestLogger(sink_url=url, deployment="dep", predictor="pred")
+        req = payloads.build_message(
+            np.array([[1.0, 2.0]], np.float32), names=["x", "y"],
+            kind="ndarray",
+        )
+        resp = payloads.build_message(
+            np.array([[0.9]], np.float32), names=["p"], kind="ndarray",
+        )
+        resp.meta.puid = "puid-1"
+        rl.log_pair(req, resp, "puid-1")
+        for _ in range(100):
+            if rl.sent >= 2:
+                break
+            await asyncio.sleep(0.02)
+        await rl.close()
+        await runner.cleanup()
+        return store, rl
+
+    store, rl = asyncio.run(run())
+    assert rl.sent == 2 and rl.dropped == 0
+    kinds = sorted(d["kind"] for d in store)
+    assert kinds == ["request", "response"]
+    req_doc = next(d for d in store if d["kind"] == "request")
+    assert req_doc["x"] == 1.0 and req_doc["y"] == 2.0
+    assert req_doc["request_id"] == "puid-1"
+    assert req_doc["deployment"] == "dep" and req_doc["predictor"] == "pred"
+
+
+def test_disabled_logger_is_free():
+    rl = RequestLogger(sink_url="", log_requests=False, log_responses=False)
+    assert not rl.enabled
+    # No loop running; must not touch asyncio at all.
+    rl.log_pair(payloads.build_message(np.zeros((1, 1))),
+                payloads.build_message(np.zeros((1, 1))), "p")
+    assert rl.sent == 0 and rl._queue is None
+
+
+def test_unreachable_sink_drops_not_blocks():
+    async def run():
+        rl = RequestLogger(sink_url="http://127.0.0.1:9/", max_queue=4)
+        msg = payloads.build_message(np.zeros((1, 1), np.float32))
+        import time
+        t0 = time.perf_counter()
+        for i in range(10):
+            rl.log_pair(msg, msg, f"p{i}")
+        hot_path_s = time.perf_counter() - t0
+        await asyncio.sleep(0.3)
+        await rl.close()
+        return hot_path_s, rl
+
+    hot_path_s, rl = asyncio.run(run())
+    assert hot_path_s < 0.2  # enqueue-only; never awaits the sink
+    assert rl.sent == 0
+    assert rl.dropped >= 6  # 20 events, queue of 4: most drop
+
+
+def test_stdout_raw_logging(capsys):
+    async def run():
+        rl = RequestLogger(sink_url="", log_requests=True, log_responses=True)
+        msg = payloads.build_message(np.ones((1, 1), np.float32), kind="ndarray")
+        rl.log_pair(msg, msg, "p")
+        await rl.close()
+
+    asyncio.run(run())
+    out = capsys.readouterr().out
+    assert out.count("Request: ") == 1 and out.count("Response: ") == 1
+    json.loads(out.splitlines()[0].split("Request: ", 1)[1])  # valid JSON

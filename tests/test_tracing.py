@@ -1,0 +1,165 @@
+"""Distributed tracing: one trace id spans engine -> units -> model.
+
+Reference capability: Jaeger tracing gated by TRACING=1 with spans
+propagated engine -> every unit (TracingProvider.java:1-37,
+python/seldon_core/microservice.py:115-150). Here: W3C traceparent over
+gRPC metadata / HTTP headers, asserted over REAL in-process sockets."""
+
+import asyncio
+import json
+
+import grpc
+import numpy as np
+import pytest
+
+from seldon_tpu.core import payloads, tracing
+from seldon_tpu.proto import prediction_pb2 as pb
+
+
+# ---------------------------------------------------------------------------
+# Tracer core
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_export():
+    exp = tracing.InMemoryExporter()
+    tracer = tracing.Tracer("svc", exporter=exp)
+    with tracer.span("root") as root:
+        with tracer.span("child") as child:
+            child.set_attribute("k", 1)
+    assert len(exp.spans) == 2
+    c, r = exp.spans  # children finish first
+    assert c.name == "child" and r.name == "root"
+    assert c.trace_id == r.trace_id
+    assert c.parent_id == r.span_id
+    assert r.parent_id is None
+    assert c.attributes == {"k": 1}
+    assert c.end_ns >= c.start_ns
+
+
+def test_traceparent_roundtrip():
+    ctx = tracing.SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+    tp = ctx.to_traceparent()
+    back = tracing.SpanContext.from_traceparent(tp)
+    assert back == ctx
+    assert tracing.SpanContext.from_traceparent("garbage") is None
+    # Case-insensitive key + bytes value (gRPC metadata shape).
+    got = tracing.Tracer.extract([("TraceParent", tp.encode())])
+    assert got == ctx
+
+
+def test_error_status_recorded():
+    exp = tracing.InMemoryExporter()
+    tracer = tracing.Tracer("svc", exporter=exp)
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("x")
+    assert exp.spans[0].status.startswith("ERROR")
+
+
+def test_disabled_tracer_is_noop():
+    t = tracing.get_tracer("svc")  # TRACING unset in tests
+    with t.span("x") as s:
+        s.set_attribute("a", 1)  # must not raise
+    assert tracing.current_span() is None
+    assert tracing.inject_current({}) == {}
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: engine -> gRPC units share one trace
+# ---------------------------------------------------------------------------
+
+
+class _Plus:
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) + 1.0
+
+
+def _spec_two_hop(port_a, port_b):
+    from seldon_tpu.orchestrator.spec import (
+        Endpoint, EndpointType, PredictiveUnit, PredictorSpec,
+    )
+
+    leaf = PredictiveUnit(
+        name="model-b", type="MODEL",
+        endpoint=Endpoint("127.0.0.1", port_b, EndpointType.GRPC),
+    )
+    root = PredictiveUnit(
+        name="transformer-a", type="TRANSFORMER",
+        endpoint=Endpoint("127.0.0.1", port_a, EndpointType.GRPC),
+        children=[leaf],
+    )
+    return PredictorSpec(name="p", graph=root)
+
+
+def test_one_trace_spans_engine_and_units(tmp_path, monkeypatch):
+    from seldon_tpu.orchestrator.walker import PredictorEngine
+    from seldon_tpu.runtime.wrapper import build_grpc_server
+
+    trace_file = tmp_path / "spans.jsonl"
+    monkeypatch.setenv("TRACING", "1")
+    monkeypatch.setenv("TRACING_FILE", str(trace_file))
+
+    class _TI:
+        def transform_input(self, X, names, meta=None):
+            return np.asarray(X) * 2.0
+
+    srv_a = build_grpc_server(_TI())
+    port_a = srv_a.add_insecure_port("127.0.0.1:0")
+    srv_a.start()
+    srv_b = build_grpc_server(_Plus())
+    port_b = srv_b.add_insecure_port("127.0.0.1:0")
+    srv_b.start()
+    try:
+        engine = PredictorEngine(_spec_two_hop(port_a, port_b))
+        req = payloads.build_message(np.array([[1.0, 2.0]], np.float32))
+        out = asyncio.run(engine.predict(req))
+        np.testing.assert_allclose(
+            payloads.get_data_from_message(out), [[3.0, 5.0]]
+        )
+    finally:
+        srv_a.stop(0)
+        srv_b.stop(0)
+
+    spans = [json.loads(l) for l in trace_file.read_text().splitlines()]
+    by_name = {s["name"]: s for s in spans}
+    # engine root + 2 graph-walk spans + 2 unit-side spans, ONE trace id.
+    assert set(by_name) >= {
+        "engine.predict", "unit.transformer-a", "unit.model-b",
+        "unit.transform-input", "unit.predict",
+    }, sorted(by_name)
+    trace_ids = {s["trace_id"] for s in spans}
+    assert len(trace_ids) == 1, spans
+    # Parenting: unit-side span's parent is the engine-side unit span.
+    assert (by_name["unit.predict"]["parent_id"]
+            == by_name["unit.model-b"]["span_id"])
+    assert (by_name["unit.transform-input"]["parent_id"]
+            == by_name["unit.transformer-a"]["span_id"])
+    assert by_name["engine.predict"]["parent_id"] is None
+    # Services attributed correctly across the process boundary.
+    assert by_name["engine.predict"]["service"] == "engine"
+
+
+def test_incoming_traceparent_becomes_root(tmp_path, monkeypatch):
+    """A client-supplied traceparent header parents the whole server-side
+    trace (the REST engine entry path)."""
+    from seldon_tpu.orchestrator.spec import PredictiveUnit, PredictorSpec
+    from seldon_tpu.orchestrator.walker import PredictorEngine
+
+    trace_file = tmp_path / "spans.jsonl"
+    monkeypatch.setenv("TRACING", "1")
+    monkeypatch.setenv("TRACING_FILE", str(trace_file))
+
+    spec = PredictorSpec(
+        name="p",
+        graph=PredictiveUnit(name="m", type="MODEL",
+                             implementation="SIMPLE_MODEL"),
+    )
+    engine = PredictorEngine(spec)
+    client_ctx = tracing.SpanContext("ee" * 16, "ff" * 8)
+    req = payloads.build_message(np.array([[1.0]], np.float32))
+    asyncio.run(engine.predict(req, trace_parent=client_ctx))
+    spans = [json.loads(l) for l in trace_file.read_text().splitlines()]
+    root = next(s for s in spans if s["name"] == "engine.predict")
+    assert root["trace_id"] == "ee" * 16
+    assert root["parent_id"] == "ff" * 8
