@@ -9,10 +9,18 @@ into meta.tags and scores into custom metrics).
 
 from seldon_tpu.components.routers import EpsilonGreedy, ThompsonSampling
 from seldon_tpu.components.outliers import MahalanobisDetector, ZScoreDetector
+from seldon_tpu.components.outliers_learned import (
+    IsolationForestDetector,
+    Seq2SeqLSTMDetector,
+    VAEDetector,
+)
 
 __all__ = [
     "EpsilonGreedy",
     "ThompsonSampling",
     "MahalanobisDetector",
     "ZScoreDetector",
+    "VAEDetector",
+    "IsolationForestDetector",
+    "Seq2SeqLSTMDetector",
 ]

@@ -1,0 +1,517 @@
+"""Learned outlier detectors on TPU: VAE, Isolation Forest, Seq2Seq-LSTM.
+
+Reference families: components/outlier-detection/vae/CoreVAE.py:80-92
+(keras MLP-VAE, score = reconstruction MSE), CoreIsolationForest.py:36-48
+(sklearn wrapper, score = -decision_function), and
+seq2seq-lstm/CoreSeq2SeqLSTM.py:81-93 (keras LSTM encoder-decoder, score
+= per-feature reconstruction error).
+
+TPU-native redesign (no keras/sklearn in this image, and CPU loops would
+waste the chip anyway):
+ * VAE and Seq2Seq are small functional JAX models — training steps are
+   jitted (optax Adam), scoring is one batched forward on device.
+ * Isolation forest is host-built (tree construction is inherently
+   sequential/random) but compiled to flat arrays and SCORED on device
+   with the same branchless gather-traversal trick as ops/trees.py —
+   [batch, n_trees] cursors, `max_depth` rounds, no Python recursion.
+ * All three share the MODEL/TRANSFORMER duality + thread-local verdict
+   plumbing of components/outliers.py and pickle cleanly for the
+   persistence layer (params stored as numpy pytrees).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seldon_tpu.components.outliers import _TagMetricsMixin
+
+
+def _to_numpy(tree):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+# ---------------------------------------------------------------------------
+# VAE
+# ---------------------------------------------------------------------------
+
+
+class VAEDetector(_TagMetricsMixin):
+    """MLP variational autoencoder; outlier score = reconstruction MSE in
+    standardized feature space, averaged over `n_mc` posterior samples
+    (reference CoreVAE._get_preds semantics)."""
+
+    def __init__(self, threshold: float = 10.0, latent_dim: int = 2,
+                 hidden_dims: Sequence[int] = (), n_mc: int = 8,
+                 seed: int = 0):
+        self.threshold = float(threshold)
+        self.latent_dim = int(latent_dim)
+        self.hidden_dims = tuple(int(h) for h in hidden_dims)
+        self.n_mc = int(n_mc)
+        self.seed = int(seed)
+        self.params = None  # numpy pytree after fit()
+        self.mu_ = None  # feature standardization
+        self.sigma_ = None
+        self._tls_obj = threading.local()
+        self._score_jit = None
+
+    # -- model ---------------------------------------------------------------
+
+    def _dims(self, n_features: int) -> List[int]:
+        if self.hidden_dims:
+            return list(self.hidden_dims)
+        # Reference default: halve until just above latent dim.
+        dims, d = [], n_features
+        while d // 2 > self.latent_dim:
+            d = d // 2
+            dims.append(max(d, self.latent_dim + 1))
+            if len(dims) >= 2:
+                break
+        return dims or [max(n_features // 2, self.latent_dim + 1)]
+
+    def _init_params(self, key, n_features: int):
+        import jax
+        import jax.numpy as jnp
+
+        dims = self._dims(n_features)
+        enc_sizes = [n_features] + dims
+        dec_sizes = [self.latent_dim] + dims[::-1] + [n_features]
+        keys = iter(jax.random.split(key, 64))
+
+        def dense(key, din, dout):
+            scale = (2.0 / din) ** 0.5
+            return {
+                "w": jax.random.normal(key, (din, dout), jnp.float32) * scale,
+                "b": jnp.zeros((dout,), jnp.float32),
+            }
+
+        return {
+            "enc": [dense(next(keys), a, b)
+                    for a, b in zip(enc_sizes[:-1], enc_sizes[1:])],
+            "mean": dense(next(keys), enc_sizes[-1], self.latent_dim),
+            "logvar": dense(next(keys), enc_sizes[-1], self.latent_dim),
+            "dec": [dense(next(keys), a, b)
+                    for a, b in zip(dec_sizes[:-1], dec_sizes[1:])],
+        }
+
+    @staticmethod
+    def _apply(params, X, key, n_samples: int = 1):
+        """-> (recon [n_samples,B,F], z_mean, z_logvar)."""
+        import jax
+        import jax.numpy as jnp
+
+        h = X
+        for lyr in params["enc"]:
+            h = jnp.tanh(h @ lyr["w"] + lyr["b"])
+        z_mean = h @ params["mean"]["w"] + params["mean"]["b"]
+        z_logvar = h @ params["logvar"]["w"] + params["logvar"]["b"]
+        eps = jax.random.normal(
+            key, (n_samples,) + z_mean.shape, z_mean.dtype
+        )
+        z = z_mean[None] + jnp.exp(0.5 * z_logvar)[None] * eps
+        h = z
+        for lyr in params["dec"][:-1]:
+            h = jnp.tanh(h @ lyr["w"] + lyr["b"])
+        out = h @ params["dec"][-1]["w"] + params["dec"][-1]["b"]
+        return out, z_mean, z_logvar
+
+    # -- training ------------------------------------------------------------
+
+    def fit(self, X: np.ndarray, epochs: int = 40, batch_size: int = 128,
+            lr: float = 1e-3, kl_weight: float = 1.0) -> "VAEDetector":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        X = np.asarray(X, np.float32)
+        self.mu_ = X.mean(axis=0)
+        self.sigma_ = X.std(axis=0) + 1e-8
+        Xs = (X - self.mu_) / self.sigma_
+        n, f = Xs.shape
+        key = jax.random.key(self.seed)
+        key, pkey = jax.random.split(key)
+        params = self._init_params(pkey, f)
+        opt = optax.adam(lr)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, xb, k):
+            recon, z_mean, z_logvar = self._apply(p, xb, k, 1)
+            mse = jnp.mean((recon[0] - xb) ** 2)
+            kl = -0.5 * jnp.mean(
+                1 + z_logvar - z_mean**2 - jnp.exp(z_logvar)
+            )
+            return mse + kl_weight * kl / f
+
+        @jax.jit
+        def step(p, s, xb, k):
+            loss, grads = jax.value_and_grad(loss_fn)(p, xb, k)
+            updates, s = opt.update(grads, s)
+            return optax.apply_updates(p, updates), s, loss
+
+        bs = min(batch_size, n)
+        rng = np.random.default_rng(self.seed)
+        xd = jnp.asarray(Xs)
+        for _ in range(epochs):
+            order = rng.permutation(n)
+            for i in range(0, n - bs + 1, bs):
+                key, sk = jax.random.split(key)
+                step_batch = xd[order[i: i + bs]]
+                params, opt_state, _ = step(params, opt_state, step_batch, sk)
+        self.params = _to_numpy(params)
+        return self
+
+    # -- scoring -------------------------------------------------------------
+
+    def _scores(self, X: np.ndarray) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        if self.params is None:
+            raise RuntimeError("VAEDetector.fit() (or load) required first")
+        Xs = (np.asarray(X, np.float32) - self.mu_) / self.sigma_
+        if self._score_jit is None:
+            # Cache the compiled scorer: jit caches key on function
+            # identity, so a per-call closure would retrace every request.
+            n_mc = self.n_mc
+
+            @jax.jit
+            def score(p, xb, k):
+                recon, _, _ = VAEDetector._apply(p, xb, k, n_mc)
+                return jnp.mean((recon - xb[None]) ** 2, axis=(0, 2))
+
+            self._score_jit = score
+        return np.asarray(
+            self._score_jit(
+                self.params, jnp.asarray(Xs), jax.random.key(self.seed)
+            )
+        )
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None) -> np.ndarray:
+        s = self._scores(np.atleast_2d(np.asarray(X, np.float32)))
+        self._last_scores = s
+        return s
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_tls_obj", None)
+        d.pop("_score_jit", None)  # compiled executables don't pickle
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._tls_obj = threading.local()
+        self._score_jit = None
+
+
+# ---------------------------------------------------------------------------
+# Isolation forest
+# ---------------------------------------------------------------------------
+
+
+def _c(n: float) -> float:
+    """Average unsuccessful-search path length in a BST of n nodes."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (math.log(n - 1) + 0.5772156649) - 2.0 * (n - 1) / n
+
+
+class IsolationForestDetector(_TagMetricsMixin):
+    """Isolation forest: host-built random trees, device-scored traversal.
+
+    Score = 2^(-E[h(x)]/c(sub_sample)) in [0,1]; higher = more anomalous
+    (the reference's sklearn wrapper exposes -decision_function, a shifted
+    version of the same quantity)."""
+
+    def __init__(self, threshold: float = 0.6, n_trees: int = 100,
+                 sub_sample: int = 256, seed: int = 0):
+        self.threshold = float(threshold)
+        self.n_trees = int(n_trees)
+        self.sub_sample = int(sub_sample)
+        self.seed = int(seed)
+        self.arrays = None  # (feature, thresh, left, right, pathlen) flat
+        self.max_depth = 0
+        self._tls_obj = threading.local()
+
+    def fit(self, X: np.ndarray) -> "IsolationForestDetector":
+        X = np.asarray(X, np.float32)
+        n = X.shape[0]
+        psi = min(self.sub_sample, n)
+        depth_cap = max(1, int(math.ceil(math.log2(max(psi, 2)))))
+        rng = np.random.default_rng(self.seed)
+        trees = []
+
+        def build(rows: np.ndarray, depth: int, nodes: list) -> int:
+            nid = len(nodes)
+            nodes.append(None)
+            if depth >= depth_cap or len(rows) <= 1:
+                # Leaf: isolation path length = depth + c(|rows|) correction.
+                nodes[nid] = (-1, 0.0, nid, nid, depth + _c(len(rows)))
+                return nid
+            f = int(rng.integers(0, X.shape[1]))
+            lo, hi = X[rows, f].min(), X[rows, f].max()
+            if lo == hi:
+                nodes[nid] = (-1, 0.0, nid, nid, depth + _c(len(rows)))
+                return nid
+            thr = float(rng.uniform(lo, hi))
+            lrows = rows[X[rows, f] < thr]
+            rrows = rows[X[rows, f] >= thr]
+            li = build(lrows, depth + 1, nodes)
+            ri = build(rrows, depth + 1, nodes)
+            nodes[nid] = (f, thr, li, ri, 0.0)
+            return nid
+
+        for _ in range(self.n_trees):
+            rows = rng.choice(n, size=psi, replace=False)
+            nodes: list = []
+            build(rows, 0, nodes)
+            trees.append(nodes)
+
+        max_nodes = max(len(t) for t in trees)
+        T = len(trees)
+        feature = np.full((T, max_nodes), -1, np.int32)
+        thresh = np.zeros((T, max_nodes), np.float32)
+        left = np.zeros((T, max_nodes), np.int32)
+        right = np.zeros((T, max_nodes), np.int32)
+        pathlen = np.zeros((T, max_nodes), np.float32)
+        for i, t in enumerate(trees):
+            for j, (f, th, l, r, pl) in enumerate(t):
+                feature[i, j] = f
+                thresh[i, j] = th
+                left[i, j] = l
+                right[i, j] = r
+                pathlen[i, j] = pl
+        self.arrays = (feature, thresh, left, right, pathlen)
+        self.max_depth = depth_cap
+        self._cn = _c(psi)
+        return self
+
+    def _scores(self, X: np.ndarray) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        if self.arrays is None:
+            raise RuntimeError("IsolationForestDetector.fit() required first")
+        feature, thresh, left, right, pathlen = (
+            jnp.asarray(a) for a in self.arrays
+        )
+        Xd = jnp.asarray(np.asarray(X, np.float32))
+        B, T = Xd.shape[0], feature.shape[0]
+        tree_idx = jnp.arange(T)[None, :]
+        node = jnp.zeros((B, T), jnp.int32)
+
+        def step(_, node):
+            f = feature[tree_idx, node]
+            is_leaf = f < 0
+            x = jnp.take_along_axis(Xd, jnp.maximum(f, 0), axis=1)
+            nxt = jnp.where(
+                x < thresh[tree_idx, node],
+                left[tree_idx, node], right[tree_idx, node],
+            )
+            return jnp.where(is_leaf, node, nxt)
+
+        node = jax.lax.fori_loop(0, self.max_depth + 1, step, node)
+        mean_path = pathlen[tree_idx, node].mean(axis=1)
+        return np.asarray(2.0 ** (-mean_path / max(self._cn, 1e-9)))
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None) -> np.ndarray:
+        s = self._scores(np.atleast_2d(np.asarray(X, np.float32)))
+        self._last_scores = s
+        return s
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_tls_obj", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._tls_obj = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# Seq2Seq LSTM
+# ---------------------------------------------------------------------------
+
+
+class Seq2SeqLSTMDetector(_TagMetricsMixin):
+    """LSTM encoder-decoder; outlier score = per-sequence reconstruction
+    MSE in standardized space. Input [B, T, F] (or [B, T] for univariate).
+
+    The LSTM is a hand-rolled cell under `lax.scan` — one traced step,
+    static shapes, fused by XLA; both training and scoring are jitted."""
+
+    def __init__(self, threshold: float = 0.3, hidden_dim: int = 32,
+                 seed: int = 0):
+        self.threshold = float(threshold)
+        self.hidden_dim = int(hidden_dim)
+        self.seed = int(seed)
+        self.params = None
+        self.mu_ = None
+        self.sigma_ = None
+        self._tls_obj = threading.local()
+        self._score_jit = None
+
+    # -- model ---------------------------------------------------------------
+
+    def _init_params(self, key, n_features: int):
+        import jax
+        import jax.numpy as jnp
+
+        H, F = self.hidden_dim, n_features
+        k = iter(jax.random.split(key, 8))
+
+        def mat(key, din, dout):
+            return jax.random.normal(key, (din, dout), jnp.float32) * (
+                1.0 / max(din, 1)
+            ) ** 0.5
+
+        def lstm(key):
+            k1, k2 = jax.random.split(key)
+            return {
+                "wx": mat(k1, F, 4 * H),
+                "wh": mat(k2, H, 4 * H),
+                "b": jnp.zeros((4 * H,), jnp.float32),
+            }
+
+        return {
+            "enc": lstm(next(k)),
+            "dec": lstm(next(k)),
+            "out": {"w": mat(next(k), H, F),
+                    "b": jnp.zeros((F,), jnp.float32)},
+        }
+
+    @staticmethod
+    def _cell(p, x, h, c):
+        import jax.numpy as jnp
+
+        import jax
+
+        gates = x @ p["wx"] + h @ p["wh"] + p["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, c
+
+    @classmethod
+    def _recon(cls, params, X):
+        """X [B,T,F] -> reconstruction [B,T,F]."""
+        import jax
+        import jax.numpy as jnp
+
+        B, T, F = X.shape
+        H = params["enc"]["wh"].shape[0]
+        h0 = jnp.zeros((B, H), X.dtype)
+
+        def enc_step(carry, xt):
+            h, c = carry
+            h, c = cls._cell(params["enc"], xt, h, c)
+            return (h, c), None
+
+        (h, c), _ = jax.lax.scan(
+            enc_step, (h0, h0), X.transpose(1, 0, 2)
+        )
+
+        def dec_step(carry, xt):
+            h, c = carry
+            h, c = cls._cell(params["dec"], xt, h, c)
+            y = h @ params["out"]["w"] + params["out"]["b"]
+            return (h, c), y
+
+        # Teacher-forced on the (shifted) input, like the reference decoder.
+        dec_in = jnp.concatenate([jnp.zeros_like(X[:, :1]), X[:, :-1]], 1)
+        (_, _), ys = jax.lax.scan(
+            dec_step, (h, c), dec_in.transpose(1, 0, 2)
+        )
+        return ys.transpose(1, 0, 2)
+
+    # -- training ------------------------------------------------------------
+
+    def fit(self, X: np.ndarray, epochs: int = 60, batch_size: int = 64,
+            lr: float = 1e-2) -> "Seq2SeqLSTMDetector":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        X = self._shape(X)
+        self.mu_ = X.mean(axis=(0, 1))
+        self.sigma_ = X.std(axis=(0, 1)) + 1e-8
+        Xs = (X - self.mu_) / self.sigma_
+        n = Xs.shape[0]
+        key = jax.random.key(self.seed)
+        params = self._init_params(key, Xs.shape[2])
+        opt = optax.adam(lr)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, xb):
+            return jnp.mean((self._recon(p, xb) - xb) ** 2)
+
+        @jax.jit
+        def step(p, s, xb):
+            loss, grads = jax.value_and_grad(loss_fn)(p, xb)
+            updates, s = opt.update(grads, s)
+            return optax.apply_updates(p, updates), s, loss
+
+        bs = min(batch_size, n)
+        rng = np.random.default_rng(self.seed)
+        xd = jnp.asarray(Xs)
+        for _ in range(epochs):
+            order = rng.permutation(n)
+            for i in range(0, n - bs + 1, bs):
+                params, opt_state, _ = step(params, opt_state,
+                                            xd[order[i: i + bs]])
+        self.params = _to_numpy(params)
+        return self
+
+    # -- scoring -------------------------------------------------------------
+
+    @staticmethod
+    def _shape(X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        if X.ndim == 2:  # [B, T] univariate
+            X = X[..., None]
+        if X.ndim != 3:
+            raise ValueError(f"expected [B,T] or [B,T,F], got {X.shape}")
+        return X
+
+    def _scores(self, X: np.ndarray) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        if self.params is None:
+            raise RuntimeError("Seq2SeqLSTMDetector.fit() required first")
+        Xs = (self._shape(X) - self.mu_) / self.sigma_
+        if self._score_jit is None:
+
+            @jax.jit
+            def score(p, xb):
+                return jnp.mean(
+                    (Seq2SeqLSTMDetector._recon(p, xb) - xb) ** 2,
+                    axis=(1, 2),
+                )
+
+            self._score_jit = score
+        return np.asarray(self._score_jit(self.params, jnp.asarray(Xs)))
+
+    def predict(self, X: np.ndarray, names: Iterable[str],
+                meta: Optional[Dict] = None) -> np.ndarray:
+        s = self._scores(X)
+        self._last_scores = s
+        return s
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_tls_obj", None)
+        d.pop("_score_jit", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._tls_obj = threading.local()
+        self._score_jit = None

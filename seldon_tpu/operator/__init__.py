@@ -28,6 +28,7 @@ from seldon_tpu.operator.webhook import (
     validate_deployment,
 )
 from seldon_tpu.operator.reconciler import Reconciler, InMemoryStore
+from seldon_tpu.operator.kubestore import KubeStore
 
 __all__ = [
     "SeldonDeployment",
@@ -37,4 +38,5 @@ __all__ = [
     "validate_deployment",
     "Reconciler",
     "InMemoryStore",
+    "KubeStore",
 ]

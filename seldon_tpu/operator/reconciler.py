@@ -285,11 +285,6 @@ def build_predictor_manifests(
             "labels": pod_labels,
         },
         "spec": {
-            "replicas": (
-                pred.spec.replicas * pred.tpu.hosts
-                if multi_host
-                else pred.spec.replicas
-            ),
             "selector": {"matchLabels": {"app": dep_name}},
             "template": {
                 "metadata": {
@@ -304,6 +299,15 @@ def build_predictor_manifests(
             },
         },
     }
+    # When an HPA owns the replica count, omitting .spec.replicas stops
+    # every reconcile PUT from resetting what the autoscaler set
+    # (reference omits replicas when hpaSpec is present).
+    if pred.hpa is None:
+        workload["spec"]["replicas"] = (
+            pred.spec.replicas * pred.tpu.hosts
+            if multi_host
+            else pred.spec.replicas
+        )
     if multi_host:
         # Stable ordinals for jax.distributed: pod-0..pod-(hosts-1) form one
         # slice; headless service gives them DNS identity. The env goes on
@@ -423,6 +427,151 @@ def machine_engine_name(sdep: T.SeldonDeployment, pred: T.PredictorExt) -> str:
     return T.machine_name(sdep.name, pred.spec.name, "svc-orch")
 
 
+def build_hpa_manifest(sdep: T.SeldonDeployment,
+                       pred: T.PredictorExt) -> Dict:
+    """HorizontalPodAutoscaler targeting the predictor workload (reference
+    createHpa, seldondeployment_controller.go:87-109). Defaults to a CPU
+    utilization metric when the CR gives none — scale signals for a TPU
+    serving pod come from the engine's req/s via custom metrics when
+    configured."""
+    dep_name = T.predictor_deployment_name(sdep, pred)
+    hpa = pred.hpa or T.HpaSpec()
+    metrics = hpa.metrics or [
+        {
+            "type": "Resource",
+            "resource": {
+                "name": "cpu",
+                "target": {"type": "Utilization", "averageUtilization": 80},
+            },
+        }
+    ]
+    spec: Dict[str, Any] = {
+        "scaleTargetRef": {
+            "apiVersion": "apps/v1",
+            # Multi-host slices deploy as StatefulSets of the same name.
+            "kind": "StatefulSet" if pred.tpu.hosts > 1 else "Deployment",
+            "name": dep_name,
+        },
+        "maxReplicas": hpa.max_replicas,
+        "metrics": metrics,
+    }
+    if hpa.min_replicas is not None:
+        spec["minReplicas"] = hpa.min_replicas
+    return {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {
+            "name": dep_name,
+            "namespace": sdep.namespace,
+            "labels": {DEPLOYMENT_LABEL: sdep.name},
+        },
+        "spec": spec,
+    }
+
+
+def build_explainer_manifests(sdep: T.SeldonDeployment,
+                              pred: T.PredictorExt) -> List[Dict]:
+    """Explainer Deployment + Service pointing back at the predictor
+    (reference seldondeployment_explainers.go:33-194: separate deployment
+    running the explainer against the predictor's endpoint, with its own
+    `-explainer` ingress route)."""
+    exp = pred.explainer
+    if exp is None or not exp.type:
+        return []
+    dep_name = T.explainer_deployment_name(sdep, pred)
+    pred_svc = T.predictor_service_name(sdep, pred)
+    port_name = "grpc" if exp.endpoint_type.upper() == "GRPC" else "http"
+    predictor_host = (
+        f"{pred_svc}.{sdep.namespace}.svc.cluster.local:"
+        + str(T.ENGINE_GRPC_PORT if port_name == "grpc"
+              else T.ENGINE_HTTP_PORT)
+    )
+    args = [
+        f"--model-name={sdep.name}",
+        f"--predictor-host={predictor_host}",
+        f"--protocol=seldon.{port_name}",
+        f"--http-port={exp.service_port}",
+        exp.type.lower(),
+    ]
+    container: Dict[str, Any] = {
+        "name": dep_name,
+        "image": exp.image or T.DEFAULT_EXPLAINER_IMAGE,
+        "imagePullPolicy": "IfNotPresent",
+        "args": args,
+        "ports": [
+            {"name": port_name, "containerPort": exp.service_port,
+             "protocol": "TCP"},
+        ],
+        "livenessProbe": {
+            "tcpSocket": {"port": port_name},
+            "initialDelaySeconds": 60, "periodSeconds": 5,
+            "failureThreshold": 5,
+        },
+        "readinessProbe": {
+            "tcpSocket": {"port": port_name},
+            "initialDelaySeconds": 20, "periodSeconds": 5,
+            "failureThreshold": 7,
+        },
+        "lifecycle": {
+            "preStop": {
+                "exec": {"command": ["/bin/sh", "-c", "/bin/sleep 10"]}
+            }
+        },
+    }
+    volumes = []
+    if exp.model_uri:
+        container["args"].insert(-1, "--storage-uri=/mnt/models")
+        vol = f"{dep_name}-model"
+        container["volumeMounts"] = [
+            {"name": vol, "mountPath": "/mnt/models", "readOnly": True}
+        ]
+        volumes.append({"name": vol, "emptyDir": {}})
+    labels = {DEPLOYMENT_LABEL: sdep.name,
+              "seldon-predictor": pred.spec.name}
+    pod_spec: Dict[str, Any] = {"containers": [container]}
+    if exp.model_uri:
+        pod_spec["initContainers"] = [
+            {
+                "name": "model-initializer",
+                "image": "seldon-tpu/storage-initializer:0.1.0",
+                "args": [exp.model_uri, "/mnt/models"],
+                "volumeMounts": container["volumeMounts"],
+            }
+        ]
+        pod_spec["volumes"] = volumes
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": dep_name,
+            "namespace": sdep.namespace,
+            "labels": labels,
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": dep_name}},
+            "template": {
+                "metadata": {"labels": {"app": dep_name, **labels}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": dep_name,
+            "namespace": sdep.namespace,
+            "labels": labels,
+        },
+        "spec": {
+            "selector": {"app": dep_name},
+            "ports": [{"port": exp.service_port, "name": port_name}],
+        },
+    }
+    return [deployment, service]
+
+
 def build_istio_manifests(sdep: T.SeldonDeployment) -> List[Dict]:
     """VirtualService with per-predictor traffic weights + DestinationRules
     (reference seldondeployment_controller.go:113-224)."""
@@ -455,6 +604,39 @@ def build_istio_manifests(sdep: T.SeldonDeployment) -> List[Dict]:
                 },
             }
         )
+    http_blocks = [
+        {
+            "match": [
+                {"uri": {"prefix": f"/seldon/{sdep.namespace}/{sdep.name}/"}}
+            ],
+            "rewrite": {"uri": "/"},
+            "route": http_routes,
+        }
+    ]
+    # Explainer routes: own `-explainer` prefix per predictor (reference
+    # seldondeployment_explainers.go ingress wiring).
+    for pred in sdep.predictors:
+        if pred.explainer is None or not pred.explainer.type:
+            continue
+        exp_svc = T.explainer_deployment_name(sdep, pred)
+        http_blocks.insert(0, {
+            "match": [
+                {"uri": {"prefix":
+                         f"/seldon/{sdep.namespace}/{sdep.name}-explainer/"
+                         f"{pred.spec.name}/"}}
+            ],
+            "rewrite": {"uri": "/"},
+            "route": [
+                {
+                    "destination": {
+                        "host": (f"{exp_svc}.{sdep.namespace}"
+                                 ".svc.cluster.local"),
+                        "port": {"number": pred.explainer.service_port},
+                    },
+                    "weight": 100,
+                }
+            ],
+        })
     vs = {
         "apiVersion": "networking.istio.io/v1beta1",
         "kind": "VirtualService",
@@ -466,15 +648,7 @@ def build_istio_manifests(sdep: T.SeldonDeployment) -> List[Dict]:
         "spec": {
             "hosts": ["*"],
             "gateways": ["seldon-gateway"],
-            "http": [
-                {
-                    "match": [
-                        {"uri": {"prefix": f"/seldon/{sdep.namespace}/{sdep.name}/"}}
-                    ],
-                    "rewrite": {"uri": "/"},
-                    "route": http_routes,
-                }
-            ],
+            "http": http_blocks,
         },
     }
     return [vs] + drs
@@ -527,6 +701,9 @@ class Reconciler:
         manifests: List[Dict] = []
         for pred in sdep.predictors:
             manifests.extend(build_predictor_manifests(sdep, pred))
+            if pred.hpa is not None:
+                manifests.append(build_hpa_manifest(sdep, pred))
+            manifests.extend(build_explainer_manifests(sdep, pred))
         if self.istio_enabled:
             manifests.extend(build_istio_manifests(sdep))
         return manifests
@@ -548,6 +725,19 @@ class Reconciler:
             m["metadata"].setdefault("labels", {})[GENERATION_LABEL] = str(
                 sdep.generation
             )
+            if sdep.uid:
+                # In-cluster cascade GC: deleting the CR deletes everything
+                # it owns (reference: controller refs, :1129-1198).
+                m["metadata"]["ownerReferences"] = [
+                    {
+                        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+                        "kind": "SeldonDeployment",
+                        "name": sdep.name,
+                        "uid": sdep.uid,
+                        "controller": True,
+                        "blockOwnerDeletion": True,
+                    }
+                ]
             self.store.apply(m)
 
         all_ready = all(
@@ -568,13 +758,40 @@ class Reconciler:
             )
         return sdep.status
 
+    def delete_all(self, name: str, namespace: str) -> int:
+        """Remove every resource labeled for `name` (CR deleted). With
+        in-cluster ownerReferences this is redundant (cascade GC), but it
+        is the only cleanup path for stores without GC and a belt-and-
+        braces fallback when the CR predates ownerReference stamping."""
+        kinds = ["Deployment", "StatefulSet", "Service",
+                 "HorizontalPodAutoscaler"]
+        if self.istio_enabled:
+            kinds += ["VirtualService", "DestinationRule"]
+        n = 0
+        for kind in kinds:
+            for obj in self.store.list(
+                kind, namespace, {DEPLOYMENT_LABEL: name}
+            ):
+                self.store.delete(
+                    obj.get("kind", kind),
+                    obj["metadata"].get("namespace", namespace),
+                    obj["metadata"]["name"],
+                )
+                n += 1
+        return n
+
     def _gc_stale(self, sdep: T.SeldonDeployment, desired: List[Dict]) -> None:
         desired_names = {
             (m["kind"], m["metadata"]["name"]) for m in desired
         }
         stale: List[Dict] = []
-        for kind in ("Deployment", "StatefulSet", "Service",
-                     "VirtualService", "DestinationRule"):
+        kinds = ["Deployment", "StatefulSet", "Service",
+                 "HorizontalPodAutoscaler"]
+        if self.istio_enabled:
+            # Istio kinds only exist as API routes when Istio is installed;
+            # listing them on a bare cluster would 404.
+            kinds += ["VirtualService", "DestinationRule"]
+        for kind in kinds:
             for obj in self.store.list(
                 kind, sdep.namespace, {DEPLOYMENT_LABEL: sdep.name}
             ):

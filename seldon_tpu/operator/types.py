@@ -61,15 +61,86 @@ class TPUSpec:
 
 
 @dataclasses.dataclass
+class HpaSpec:
+    """Autoscaling knobs (reference SeldonPodSpec.HpaSpec, consumed by
+    createHpa, seldondeployment_controller.go:87-109)."""
+
+    max_replicas: int = 1
+    min_replicas: Optional[int] = None
+    metrics: List[Dict] = dataclasses.field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "HpaSpec":
+        return HpaSpec(
+            max_replicas=int(d.get("maxReplicas", 1)),
+            min_replicas=(
+                int(d["minReplicas"]) if "minReplicas" in d else None
+            ),
+            metrics=list(d.get("metrics", [])),
+        )
+
+    def to_dict(self) -> Dict:
+        out: Dict[str, Any] = {"maxReplicas": self.max_replicas}
+        if self.min_replicas is not None:
+            out["minReplicas"] = self.min_replicas
+        if self.metrics:
+            out["metrics"] = self.metrics
+        return out
+
+
+DEFAULT_EXPLAINER_IMAGE = "seldon-tpu/explainer:0.1.0"
+
+
+@dataclasses.dataclass
+class ExplainerSpec:
+    """Explainer sidecar deployment (reference PredictorSpec.Explainer,
+    seldondeployment_explainers.go:33-194)."""
+
+    type: str = ""  # anchor_tabular | anchor_images | ...
+    model_uri: str = ""
+    image: str = ""
+    endpoint_type: str = "GRPC"
+    service_port: int = 9000
+    config: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "ExplainerSpec":
+        ep = d.get("endpoint") or {}  # tolerate explicit null
+        return ExplainerSpec(
+            type=d.get("type", ""),
+            model_uri=d.get("modelUri", d.get("model_uri", "")),
+            image=d.get("image", ""),
+            endpoint_type=ep.get("type", "GRPC"),
+            service_port=int(ep.get("servicePort", 9000)),
+            config=dict(d.get("config") or {}),
+        )
+
+    def to_dict(self) -> Dict:
+        out: Dict[str, Any] = {"type": self.type}
+        if self.model_uri:
+            out["modelUri"] = self.model_uri
+        if self.image:
+            out["image"] = self.image
+        out["endpoint"] = {
+            "type": self.endpoint_type, "servicePort": self.service_port,
+        }
+        if self.config:
+            out["config"] = self.config
+        return out
+
+
+@dataclasses.dataclass
 class PredictorExt:
     """PredictorSpec plus operator-level fields the orchestrator spec
-    doesn't carry (componentSpecs images, tpu)."""
+    doesn't carry (componentSpecs images, tpu, hpa, explainer)."""
 
     spec: PredictorSpec
     tpu: TPUSpec = dataclasses.field(default_factory=TPUSpec)
     component_images: Dict[str, str] = dataclasses.field(default_factory=dict)
     # unit name -> container resources overrides
     resources: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    hpa: Optional[HpaSpec] = None
+    explainer: Optional[ExplainerSpec] = None
 
     @staticmethod
     def from_dict(d: Dict) -> "PredictorExt":
@@ -78,6 +149,14 @@ class PredictorExt:
             tpu=TPUSpec.from_dict(d.get("tpu", {})),
             component_images=dict(d.get("componentImages", {})),
             resources=dict(d.get("resources", {})),
+            hpa=(
+                HpaSpec.from_dict(d["hpaSpec"]) if d.get("hpaSpec") else None
+            ),
+            explainer=(
+                ExplainerSpec.from_dict(d["explainer"])
+                if (d.get("explainer") or {}).get("type")
+                else None
+            ),
         )
 
     def to_dict(self) -> Dict:
@@ -86,6 +165,10 @@ class PredictorExt:
             out["tpu"] = self.tpu.to_dict()
         if self.component_images:
             out["componentImages"] = self.component_images
+        if self.hpa is not None:
+            out["hpaSpec"] = self.hpa.to_dict()
+        if self.explainer is not None:
+            out["explainer"] = self.explainer.to_dict()
         return out
 
 
@@ -105,6 +188,7 @@ class SeldonDeployment:
     annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     generation: int = 1
+    uid: str = ""  # cluster UID; enables ownerReference GC
     oauth_key: str = ""
     status: DeploymentStatus = dataclasses.field(default_factory=DeploymentStatus)
 
@@ -118,9 +202,10 @@ class SeldonDeployment:
             predictors=[
                 PredictorExt.from_dict(p) for p in spec.get("predictors", [])
             ],
-            annotations=dict(meta.get("annotations", {})),
-            labels=dict(meta.get("labels", {})),
+            annotations=dict(meta.get("annotations") or {}),
+            labels=dict(meta.get("labels") or {}),
             generation=int(meta.get("generation", 1)),
+            uid=meta.get("uid", ""),
         )
 
     def to_dict(self) -> Dict:
@@ -171,3 +256,9 @@ def predictor_service_name(sdep: SeldonDeployment, pred: PredictorExt) -> str:
 def container_service_name(sdep: SeldonDeployment, pred: PredictorExt,
                            unit: PredictiveUnit) -> str:
     return machine_name(sdep.name, pred.spec.name, unit.name)
+
+
+def explainer_deployment_name(sdep: SeldonDeployment,
+                              pred: PredictorExt) -> str:
+    """Reference GetExplainerDeploymentName semantics."""
+    return machine_name(sdep.name, pred.spec.name, "explainer")

@@ -1,0 +1,289 @@
+"""KubeStore + ControllerLoop against a faked k8s API server.
+
+Reference test analogue: the Go operator's envtest (suite_test.go:1-84 —
+a local kube-apiserver). No kubernetes binaries ship here, so a small
+aiohttp fake implements the REST verbs KubeStore speaks: typed CRUD,
+labelSelector lists, status subresource PATCH, chunked watch."""
+
+import json
+import threading
+import time
+
+import pytest
+from aiohttp import web
+
+from seldon_tpu.operator import types as T
+from seldon_tpu.operator.controller import (
+    ControllerLoop, handle_admission_review,
+)
+from seldon_tpu.operator.kubestore import KIND_ROUTES, KubeApiError, KubeStore
+
+
+class FakeKubeApi:
+    """Minimal in-memory API server honoring the KubeStore surface."""
+
+    def __init__(self):
+        self.objects = {}  # (prefix, plural, ns, name) -> dict
+        self.rv = 0
+        self.watch_events = []  # events replayed to the next watcher
+
+    def _key(self, prefix, plural, ns, name):
+        return (prefix, plural, ns, name)
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_route(
+            "*", "/{prefix:api(?:s)?/[^/]+(?:/[^/]+)?}/namespaces/{ns}/{rest:.*}",
+            self.handle,
+        )
+        return app
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        prefix = request.match_info["prefix"]
+        ns = request.match_info["ns"]
+        rest = request.match_info["rest"].split("/")
+        plural = rest[0]
+        name = rest[1] if len(rest) > 1 and rest[1] else ""
+        sub = rest[2] if len(rest) > 2 else ""
+
+        if request.method == "GET" and not name:
+            if request.query.get("watch") == "true":
+                return await self._serve_watch(request)
+            sel = request.query.get("labelSelector", "")
+            items = []
+            for (p, pl, n, _), obj in self.objects.items():
+                if (p, pl, n) != (prefix, plural, ns):
+                    continue
+                if sel and not self._matches(obj, sel):
+                    continue
+                items.append(obj)
+            return web.json_response({"items": items})
+
+        key = self._key(prefix, plural, ns, name)
+        if request.method == "GET":
+            if key not in self.objects:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            return web.json_response(self.objects[key])
+        if request.method == "POST":
+            body = await request.json()
+            self.rv += 1
+            body.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            key = self._key(prefix, plural, ns, body["metadata"]["name"])
+            if key in self.objects:
+                return web.json_response({"reason": "Conflict"}, status=409)
+            self.objects[key] = body
+            return web.json_response(body, status=201)
+        if request.method == "PUT":
+            body = await request.json()
+            if key not in self.objects:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            live_rv = self.objects[key]["metadata"]["resourceVersion"]
+            if body["metadata"].get("resourceVersion") != live_rv:
+                return web.json_response({"reason": "Conflict"}, status=409)
+            self.rv += 1
+            body["metadata"]["resourceVersion"] = str(self.rv)
+            # Real apiservers keep the status subresource across spec PUTs.
+            if "status" not in body and "status" in self.objects[key]:
+                body["status"] = self.objects[key]["status"]
+            self.objects[key] = body
+            return web.json_response(body)
+        if request.method == "PATCH":
+            target_key = self._key(prefix, plural, ns, name)
+            if sub == "status":
+                pass  # status subresource patches the same stored object
+            if target_key not in self.objects:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            patch = await request.json()
+            obj = self.objects[target_key]
+            for k, v in patch.items():
+                obj[k] = v
+            return web.json_response(obj)
+        if request.method == "DELETE":
+            if key not in self.objects:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            del self.objects[key]
+            return web.json_response({})
+        return web.json_response({"reason": "MethodNotAllowed"}, status=405)
+
+    async def _serve_watch(self, request):
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        for ev in self.watch_events:
+            await resp.write((json.dumps(ev) + "\n").encode())
+        await resp.write_eof()  # server closes; client re-lists
+        return resp
+
+    @staticmethod
+    def _matches(obj, selector: str) -> bool:
+        labels = obj.get("metadata", {}).get("labels", {})
+        for pair in selector.split(","):
+            k, _, v = pair.partition("=")
+            if labels.get(k) != v:
+                return False
+        return True
+
+
+@pytest.fixture()
+def fake_api():
+    import asyncio
+
+    api = FakeKubeApi()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder = {}
+
+    def serve():
+        asyncio.set_event_loop(loop)
+
+        async def run():
+            runner = web.AppRunner(api.make_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port_holder["port"] = site._server.sockets[0].getsockname()[1]
+            started.set()
+
+        loop.run_until_complete(run())
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert started.wait(5)
+    yield api, f"http://127.0.0.1:{port_holder['port']}"
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def _sdep_dict(name="mymodel", generation=1):
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "namespace": "default",
+                     "generation": generation},
+        "spec": {
+            "predictors": [
+                {
+                    "name": "main",
+                    "replicas": 1,
+                    "graph": {"name": "clf", "type": "MODEL",
+                              "implementation": "JAX_SERVER",
+                              "modelUri": "file:///m"},
+                }
+            ]
+        },
+    }
+
+
+def test_kubestore_crud_roundtrip(fake_api):
+    api, url = fake_api
+    store = KubeStore(base_url=url)
+    dep = {"apiVersion": "apps/v1", "kind": "Deployment",
+           "metadata": {"name": "d1", "namespace": "default",
+                        "labels": {"app": "x"}},
+           "spec": {"replicas": 2}}
+    store.apply(dep)  # create
+    dep2 = dict(dep)
+    dep2["spec"] = {"replicas": 3}
+    store.apply(dep2)  # update (carries live resourceVersion)
+    got = store.list("Deployment", "default", {"app": "x"})
+    assert len(got) == 1 and got[0]["spec"]["replicas"] == 3
+    assert store.list("Deployment", "default", {"app": "other"}) == []
+    # readiness: no status -> not ready; patch status -> ready
+    assert not store.is_ready("Deployment", "default", "d1")
+    key = ("apis/apps/v1", "deployments", "default", "d1")
+    api.objects[key]["status"] = {"readyReplicas": 3}
+    assert store.is_ready("Deployment", "default", "d1")
+    store.delete("Deployment", "default", "d1")
+    assert store.list("Deployment", "default") == []
+    store.delete("Deployment", "default", "d1")  # 404 tolerated
+
+
+def test_controller_resync_reconciles_cr(fake_api):
+    api, url = fake_api
+    store = KubeStore(base_url=url)
+    # Seed the CR as if `kubectl apply`d.
+    prefix, plural = KIND_ROUTES["SeldonDeployment"]
+    api.objects[(prefix, plural, "default", "mymodel")] = _sdep_dict()
+    loop = ControllerLoop(store, namespace="default", istio_enabled=True)
+    n = loop.resync()
+    assert n == 1 and loop.reconcile_count == 1
+    deps = store.list("Deployment", "default")
+    assert len(deps) == 1
+    names = {c["name"] for c in
+             deps[0]["spec"]["template"]["spec"]["containers"]}
+    assert any("clf" in n for n in names)
+    svcs = store.list("Service", "default")
+    assert svcs, "predictor service missing"
+    vss = store.list("VirtualService", "default")
+    assert vss and vss[0]["spec"]["http"]
+    # Status written back to the CR (workloads have no readyReplicas yet
+    # -> Creating).
+    cr = api.objects[(prefix, plural, "default", "mymodel")]
+    assert cr["status"]["state"] == "Creating"
+    # Mark workloads ready; re-reconcile -> Available.
+    for key, obj in list(api.objects.items()):
+        if obj.get("kind") == "Deployment":
+            obj["status"] = {"readyReplicas": obj["spec"].get("replicas", 1)}
+    loop.resync()
+    assert cr["status"]["state"] == "Available"
+
+
+def test_controller_watch_events_drive_reconcile(fake_api):
+    api, url = fake_api
+    store = KubeStore(base_url=url)
+    api.watch_events = [
+        {"type": "ADDED", "object": _sdep_dict(name="watched")},
+    ]
+    loop = ControllerLoop(store, namespace="default", resync_s=0.2,
+                          istio_enabled=False)
+    t = threading.Thread(target=loop.run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and loop.reconcile_count < 1:
+        time.sleep(0.05)
+    loop.stop()
+    t.join(timeout=5)
+    assert loop.reconcile_count >= 1
+    assert store.list("Deployment", "default")
+
+
+# ---------------------------------------------------------------------------
+# Admission webhook handlers (AdmissionReview v1)
+# ---------------------------------------------------------------------------
+
+
+def test_mutating_webhook_patches_defaults():
+    review = {"request": {"uid": "u1", "object": _sdep_dict()}}
+    out = handle_admission_review(review, mutate=True)
+    resp = out["response"]
+    assert resp["allowed"] and resp["uid"] == "u1"
+    import base64
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    assert patch[0]["op"] == "replace" and patch[0]["path"] == "/spec"
+    # Defaulting assigned the unit an endpoint port.
+    graph = patch[0]["value"]["predictors"][0]["graph"]
+    assert graph.get("endpoint", {}).get("service_port",
+                                         graph.get("endpoint", {}).get(
+                                             "servicePort", 0))
+
+
+def test_validating_webhook_rejects_bad_traffic():
+    bad = _sdep_dict()
+    bad["spec"]["predictors"].append(
+        {"name": "canary", "replicas": 1, "traffic": 10,
+         "graph": {"name": "clf2", "type": "MODEL",
+                   "implementation": "JAX_SERVER", "modelUri": "file:///m"}}
+    )
+    bad["spec"]["predictors"][0]["traffic"] = 10  # sums to 20, not 100
+    out = handle_admission_review(
+        {"request": {"uid": "u2", "object": bad}}, mutate=False
+    )
+    assert out["response"]["allowed"] is False
+    assert "traffic" in out["response"]["status"]["message"].lower()
+
+
+def test_webhook_malformed_object_rejected():
+    out = handle_admission_review(
+        {"request": {"uid": "u3", "object": {"spec": {"predictors": 3}}}},
+        mutate=False,
+    )
+    assert out["response"]["allowed"] is False

@@ -315,3 +315,103 @@ def test_multihost_env_targets_tpu_container():
     assert "TPU_WORKER_HOSTNAMES_SVC" in env
     pre = next(c for c in pod["containers"] if c["name"] == "pre")
     assert "TPU_WORKER_HOSTNAMES_SVC" not in {e["name"] for e in pre["env"]}
+
+
+# ---------------------------------------------------------------------------
+# HPA + explainer (reference createHpa :87-109, explainers.go:33-194)
+# ---------------------------------------------------------------------------
+
+
+def test_hpa_manifest_shape():
+    pred = {
+        "name": "main",
+        "replicas": 1,
+        "graph": {"name": "clf", "type": "MODEL",
+                  "implementation": "JAX_SERVER",
+                  "modelUri": "file:///m"},
+        "hpaSpec": {
+            "minReplicas": 1,
+            "maxReplicas": 5,
+            "metrics": [{"type": "Resource", "resource": {
+                "name": "cpu",
+                "target": {"type": "Utilization",
+                           "averageUtilization": 60}}}],
+        },
+    }
+    sdep = fixture_cr(predictors=[pred])
+    store = InMemoryStore()
+    Reconciler(store, istio_enabled=False).reconcile(sdep)
+    hpas = store.list("HorizontalPodAutoscaler", "test")
+    assert len(hpas) == 1
+    spec = hpas[0]["spec"]
+    assert spec["maxReplicas"] == 5 and spec["minReplicas"] == 1
+    assert spec["scaleTargetRef"]["kind"] == "Deployment"
+    assert spec["scaleTargetRef"]["name"] == T.predictor_deployment_name(
+        sdep, sdep.predictors[0]
+    )
+    target = spec["metrics"][0]["resource"]["target"]
+    assert target["averageUtilization"] == 60
+
+
+def test_hpa_absent_without_spec():
+    sdep = fixture_cr()
+    store = InMemoryStore()
+    Reconciler(store, istio_enabled=False).reconcile(sdep)
+    assert store.list("HorizontalPodAutoscaler", "test") == []
+
+
+def test_explainer_deployment_and_route():
+    pred = {
+        "name": "main",
+        "replicas": 1,
+        "graph": {"name": "clf", "type": "MODEL",
+                  "implementation": "JAX_SERVER",
+                  "modelUri": "file:///m"},
+        "explainer": {
+            "type": "anchor_tabular",
+            "modelUri": "gs://bucket/explainer",
+        },
+    }
+    sdep = fixture_cr(predictors=[pred])
+    store = InMemoryStore()
+    Reconciler(store, istio_enabled=True).reconcile(sdep)
+    exp_name = T.explainer_deployment_name(sdep, sdep.predictors[0])
+    deps = {d["metadata"]["name"]: d for d in store.list("Deployment", "test")}
+    assert exp_name in deps
+    c = deps[exp_name]["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == T.DEFAULT_EXPLAINER_IMAGE
+    # Args point the explainer back at the predictor service (ref :110-120).
+    pred_svc = T.predictor_service_name(sdep, sdep.predictors[0])
+    assert any(pred_svc in a for a in c["args"] if "--predictor-host" in a)
+    assert "anchor_tabular" == c["args"][-1]
+    assert any("--storage-uri" in a for a in c["args"])  # modelUri given
+    # initContainer downloads the explainer model.
+    assert deps[exp_name]["spec"]["template"]["spec"]["initContainers"]
+    # Own service + istio -explainer route.
+    svcs = {s["metadata"]["name"] for s in store.list("Service", "test")}
+    assert exp_name in svcs
+    vs = store.list("VirtualService", "test")[0]
+    prefixes = [m["uri"]["prefix"] for b in vs["spec"]["http"]
+                for m in b["match"]]
+    assert any("-explainer/" in p for p in prefixes)
+    # Explainer probes mirror reference defaults.
+    assert c["readinessProbe"]["tcpSocket"]["port"] == "grpc"
+
+
+def test_explainer_gc_with_generation():
+    pred = {
+        "name": "main",
+        "replicas": 1,
+        "graph": {"name": "clf", "type": "MODEL",
+                  "implementation": "JAX_SERVER", "modelUri": "file:///m"},
+        "explainer": {"type": "anchor_tabular"},
+    }
+    store = InMemoryStore()
+    rec = Reconciler(store, istio_enabled=False)
+    rec.reconcile(fixture_cr(predictors=[pred], generation=1))
+    # Generation 2 drops the explainer: stale explainer resources must GC.
+    pred2 = dict(pred)
+    pred2.pop("explainer")
+    rec.reconcile(fixture_cr(predictors=[pred2], generation=2))
+    names = {d["metadata"]["name"] for d in store.list("Deployment", "test")}
+    assert not any("explainer" in n for n in names), names

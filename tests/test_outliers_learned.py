@@ -1,0 +1,140 @@
+"""Learned outlier detectors: VAE, IsolationForest, Seq2Seq-LSTM.
+
+Each must (a) separate planted anomalies from inliers after fit(),
+(b) work in both MODEL (predict) and TRANSFORMER (transform_input +
+tags/metrics) roles, (c) survive pickling (persistence layer)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from seldon_tpu.components import (
+    IsolationForestDetector, Seq2SeqLSTMDetector, VAEDetector,
+)
+
+
+@pytest.fixture(scope="module")
+def tabular_data():
+    rng = np.random.default_rng(0)
+    inliers = rng.normal(0.0, 1.0, size=(512, 8)).astype(np.float32)
+    outliers = rng.normal(6.0, 1.0, size=(16, 8)).astype(np.float32)
+    return inliers, outliers
+
+
+# ---------------------------------------------------------------------------
+# VAE
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fitted_vae(tabular_data):
+    inliers, _ = tabular_data
+    return VAEDetector(latent_dim=2, seed=0).fit(
+        inliers, epochs=30, batch_size=128
+    )
+
+
+def test_vae_separates_outliers(tabular_data, fitted_vae):
+    inliers, outliers = tabular_data
+    s_in = fitted_vae.predict(inliers[:64], [])
+    s_out = fitted_vae.predict(outliers, [])
+    # Clean separation: every planted outlier scores above every inlier mean.
+    assert s_out.min() > s_in.mean() * 2, (s_in.mean(), s_out.min())
+
+
+def test_vae_transformer_dual(tabular_data, fitted_vae):
+    inliers, outliers = tabular_data
+    det = fitted_vae
+    det.threshold = float(det.predict(inliers[:64], []).max() * 1.5)
+    out = det.transform_input(outliers[:4], [])
+    np.testing.assert_array_equal(out, outliers[:4])  # pass-through
+    assert det.tags()["outlier"] is True
+    assert det.tags()["outlier_count"] == 4
+    keys = {m["key"] for m in det.metrics()}
+    assert "outlier_score_max" in keys
+    det.transform_input(inliers[:4], [])
+    assert det.tags()["outlier"] is False
+
+
+def test_vae_pickle_roundtrip(tabular_data, fitted_vae):
+    inliers, outliers = tabular_data
+    restored = pickle.loads(pickle.dumps(fitted_vae))
+    np.testing.assert_allclose(
+        restored.predict(outliers, []), fitted_vae.predict(outliers, []),
+        rtol=1e-5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Isolation forest
+# ---------------------------------------------------------------------------
+
+
+def test_iforest_separates_outliers(tabular_data):
+    inliers, outliers = tabular_data
+    det = IsolationForestDetector(n_trees=100, seed=0).fit(inliers)
+    s_in = det.predict(inliers[:64], [])
+    s_out = det.predict(outliers, [])
+    assert s_out.mean() > s_in.mean() + 0.1, (s_in.mean(), s_out.mean())
+    # Canonical iforest property: scores in (0, 1], anomalies near ~>0.6.
+    assert 0.0 < s_in.min() and s_out.max() <= 1.0
+    assert np.median(s_out) > 0.55
+
+
+def test_iforest_pickle_and_dual(tabular_data):
+    inliers, outliers = tabular_data
+    det = IsolationForestDetector(n_trees=50, seed=1, threshold=0.55)
+    det.fit(inliers)
+    restored = pickle.loads(pickle.dumps(det))
+    np.testing.assert_allclose(
+        restored.predict(outliers, []), det.predict(outliers, []), rtol=1e-6
+    )
+    restored.transform_input(outliers[:3], [])
+    assert restored.tags()["outlier"] is True
+
+
+# ---------------------------------------------------------------------------
+# Seq2Seq LSTM
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sequence_data():
+    rng = np.random.default_rng(0)
+    t = np.linspace(0, 4 * np.pi, 32)
+    # Inliers: noisy sinusoids with random phase.
+    phases = rng.uniform(0, 2 * np.pi, size=(256, 1))
+    inliers = np.sin(t[None, :] + phases) + rng.normal(
+        0, 0.05, size=(256, 32)
+    )
+    # Anomalies: white noise bursts.
+    outliers = rng.normal(0, 1.2, size=(8, 32))
+    return inliers.astype(np.float32), outliers.astype(np.float32)
+
+
+def test_seq2seq_separates_anomalous_sequences(sequence_data):
+    inliers, outliers = sequence_data
+    det = Seq2SeqLSTMDetector(hidden_dim=24, seed=0)
+    det.fit(inliers, epochs=40, batch_size=64)
+    s_in = det.predict(inliers[:32], [])
+    s_out = det.predict(outliers, [])
+    assert s_out.mean() > 2 * s_in.mean(), (s_in.mean(), s_out.mean())
+    # Dual + pickle
+    det.threshold = float(s_in.max() * 1.5)
+    restored = pickle.loads(pickle.dumps(det))
+    restored.transform_input(outliers[:2], [])
+    assert restored.tags()["outlier"] is True
+    np.testing.assert_allclose(
+        restored.predict(outliers, []), s_out, rtol=1e-5
+    )
+
+
+def test_seq2seq_multivariate_shape():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(32, 10, 3)).astype(np.float32)
+    det = Seq2SeqLSTMDetector(hidden_dim=8, seed=0)
+    det.fit(X, epochs=2, batch_size=16)
+    assert det.predict(X[:5], []).shape == (5,)
+    with pytest.raises(ValueError):
+        det.predict(np.zeros((2, 2, 2, 2), np.float32), [])
