@@ -1,0 +1,146 @@
+"""Orchestrator-level benchmark: engine + in-process SIMPLE_MODEL graph.
+
+Reference comparison (SURVEY.md §6, doc/source/reference/benchmarking.md):
+the Java engine with the hardcoded SIMPLE_MODEL stub (no microservice
+hop) sustained 12,089 req/s REST / 28,256 req/s gRPC with p50 4ms/1ms on
+one n1-standard-16 (64 locust slaves). This driver measures the same
+thing for the asyncio engine: closed-loop concurrent clients hammering
+REST and gRPC over REAL localhost sockets against a SIMPLE_MODEL graph
+(zero model compute — pure orchestrator overhead).
+
+Prints one JSON line per transport:
+  {"metric": "engine_rest_req_per_s", "value": ..., "p50_ms": ..., ...}
+
+Env knobs: BENCH_ORCH_CLIENTS (default 64), BENCH_ORCH_SECONDS (5),
+BENCH_ORCH_TRANSPORTS (rest,grpc).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+CLIENTS = int(os.environ.get("BENCH_ORCH_CLIENTS", "64"))
+SECONDS = float(os.environ.get("BENCH_ORCH_SECONDS", "5"))
+TRANSPORTS = os.environ.get("BENCH_ORCH_TRANSPORTS", "rest,grpc").split(",")
+
+REF_REST = 12088.95  # benchmarking.md:40-44
+REF_GRPC = 28256.39  # benchmarking.md:52-58
+
+
+def build_server():
+    from seldon_tpu.orchestrator.server import EngineServer
+    from seldon_tpu.orchestrator.spec import PredictiveUnit, PredictorSpec
+
+    spec = PredictorSpec(
+        name="bench",
+        graph=PredictiveUnit(
+            name="simple", type="MODEL", implementation="SIMPLE_MODEL"
+        ),
+    )
+    # Batching off: SIMPLE_MODEL is hardcoded in-process (no leaf to fuse
+    # for) and the reference bench has no batcher either.
+    return EngineServer(spec=spec, http_port=0, grpc_port=0,
+                        enable_batching=False)
+
+
+async def bench_rest(es, seconds: float, clients: int):
+    import aiohttp
+
+    port = None
+    for site in es._runner.sites:
+        port = site._server.sockets[0].getsockname()[1]
+    url = f"http://127.0.0.1:{port}/api/v0.1/predictions"
+    body = json.dumps(
+        {"data": {"names": ["a", "b"], "ndarray": [[1.0, 2.0]]}}
+    ).encode()
+    headers = {"Content-Type": "application/json"}
+    stop_at = time.perf_counter() + seconds
+    latencies = []
+
+    async def worker(session):
+        n = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            async with session.post(url, data=body, headers=headers) as r:
+                await r.read()
+                assert r.status == 200, r.status
+            latencies.append(time.perf_counter() - t0)
+            n += 1
+        return n
+
+    conn = aiohttp.TCPConnector(limit=clients)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        t0 = time.perf_counter()
+        counts = await asyncio.gather(*[worker(session) for _ in range(clients)])
+        dt = time.perf_counter() - t0
+    return sum(counts), dt, latencies
+
+
+async def bench_grpc(es, seconds: float, clients: int):
+    import grpc.aio
+
+    from seldon_tpu.core import payloads
+    from seldon_tpu.proto import prediction_grpc
+
+    port = es.grpc_port  # bound port after start()
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    stub = prediction_grpc.SeldonStub(channel)
+    req = payloads.build_message(
+        np.array([[1.0, 2.0]], np.float32), names=["a", "b"], kind="ndarray"
+    )
+    stop_at = time.perf_counter() + seconds
+    latencies = []
+
+    async def worker():
+        n = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            await stub.Predict(req)
+            latencies.append(time.perf_counter() - t0)
+            n += 1
+        return n
+
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*[worker() for _ in range(clients)])
+    dt = time.perf_counter() - t0
+    await channel.close()
+    return sum(counts), dt, latencies
+
+
+def report(name: str, total: int, dt: float, lats, ref: float):
+    lats_ms = np.array(lats) * 1000.0
+    print(json.dumps({
+        "metric": name,
+        "value": round(total / dt, 1),
+        "unit": f"req/s ({CLIENTS} clients, SIMPLE_MODEL graph, {SECONDS}s)",
+        "vs_baseline": round(total / dt / ref, 3),
+        "detail": {
+            "requests": total,
+            "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
+            "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+            "reference_req_s": ref,
+        },
+    }))
+
+
+async def main():
+    es = build_server()
+    await es.start(host="127.0.0.1")
+    try:
+        if "rest" in TRANSPORTS:
+            total, dt, lats = await bench_rest(es, SECONDS, CLIENTS)
+            report("engine_rest_req_per_s", total, dt, lats, REF_REST)
+        if "grpc" in TRANSPORTS:
+            total, dt, lats = await bench_grpc(es, SECONDS, CLIENTS)
+            report("engine_grpc_req_per_s", total, dt, lats, REF_GRPC)
+    finally:
+        await es.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

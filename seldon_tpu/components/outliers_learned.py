@@ -59,6 +59,7 @@ class VAEDetector(_TagMetricsMixin):
         self.sigma_ = None
         self._tls_obj = threading.local()
         self._score_jit = None
+        self._params_dev = None
 
     # -- model ---------------------------------------------------------------
 
@@ -163,6 +164,7 @@ class VAEDetector(_TagMetricsMixin):
                 step_batch = xd[order[i: i + bs]]
                 params, opt_state, _ = step(params, opt_state, step_batch, sk)
         self.params = _to_numpy(params)
+        self._params_dev = params  # already device-resident
         return self
 
     # -- scoring -------------------------------------------------------------
@@ -174,6 +176,10 @@ class VAEDetector(_TagMetricsMixin):
         if self.params is None:
             raise RuntimeError("VAEDetector.fit() (or load) required first")
         Xs = (np.asarray(X, np.float32) - self.mu_) / self.sigma_
+        if self._params_dev is None:
+            # Device-resident params, uploaded once — per-request host->HBM
+            # transfer of the whole model would dominate serving latency.
+            self._params_dev = jax.tree.map(jnp.asarray, self.params)
         if self._score_jit is None:
             # Cache the compiled scorer: jit caches key on function
             # identity, so a per-call closure would retrace every request.
@@ -187,7 +193,7 @@ class VAEDetector(_TagMetricsMixin):
             self._score_jit = score
         return np.asarray(
             self._score_jit(
-                self.params, jnp.asarray(Xs), jax.random.key(self.seed)
+                self._params_dev, jnp.asarray(Xs), jax.random.key(self.seed)
             )
         )
 
@@ -201,12 +207,14 @@ class VAEDetector(_TagMetricsMixin):
         d = dict(self.__dict__)
         d.pop("_tls_obj", None)
         d.pop("_score_jit", None)  # compiled executables don't pickle
+        d.pop("_params_dev", None)  # device buffers don't pickle
         return d
 
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._tls_obj = threading.local()
         self._score_jit = None
+        self._params_dev = None
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +245,7 @@ class IsolationForestDetector(_TagMetricsMixin):
         self.arrays = None  # (feature, thresh, left, right, pathlen) flat
         self.max_depth = 0
         self._tls_obj = threading.local()
+        self._arrays_dev = None
 
     def fit(self, X: np.ndarray) -> "IsolationForestDetector":
         X = np.asarray(X, np.float32)
@@ -289,6 +298,7 @@ class IsolationForestDetector(_TagMetricsMixin):
         self.arrays = (feature, thresh, left, right, pathlen)
         self.max_depth = depth_cap
         self._cn = _c(psi)
+        self._arrays_dev = None  # refit invalidates the device copy
         return self
 
     def _scores(self, X: np.ndarray) -> np.ndarray:
@@ -297,9 +307,10 @@ class IsolationForestDetector(_TagMetricsMixin):
 
         if self.arrays is None:
             raise RuntimeError("IsolationForestDetector.fit() required first")
-        feature, thresh, left, right, pathlen = (
-            jnp.asarray(a) for a in self.arrays
-        )
+        if self._arrays_dev is None:
+            # One-time host->device upload of the forest.
+            self._arrays_dev = tuple(jnp.asarray(a) for a in self.arrays)
+        feature, thresh, left, right, pathlen = self._arrays_dev
         Xd = jnp.asarray(np.asarray(X, np.float32))
         B, T = Xd.shape[0], feature.shape[0]
         tree_idx = jnp.arange(T)[None, :]
@@ -328,11 +339,13 @@ class IsolationForestDetector(_TagMetricsMixin):
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_tls_obj", None)
+        d.pop("_arrays_dev", None)
         return d
 
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._tls_obj = threading.local()
+        self._arrays_dev = None
 
 
 # ---------------------------------------------------------------------------
@@ -357,6 +370,7 @@ class Seq2SeqLSTMDetector(_TagMetricsMixin):
         self.sigma_ = None
         self._tls_obj = threading.local()
         self._score_jit = None
+        self._params_dev = None
 
     # -- model ---------------------------------------------------------------
 
@@ -467,6 +481,7 @@ class Seq2SeqLSTMDetector(_TagMetricsMixin):
                 params, opt_state, _ = step(params, opt_state,
                                             xd[order[i: i + bs]])
         self.params = _to_numpy(params)
+        self._params_dev = params  # already device-resident
         return self
 
     # -- scoring -------------------------------------------------------------
@@ -487,6 +502,8 @@ class Seq2SeqLSTMDetector(_TagMetricsMixin):
         if self.params is None:
             raise RuntimeError("Seq2SeqLSTMDetector.fit() required first")
         Xs = (self._shape(X) - self.mu_) / self.sigma_
+        if self._params_dev is None:
+            self._params_dev = jax.tree.map(jnp.asarray, self.params)
         if self._score_jit is None:
 
             @jax.jit
@@ -497,7 +514,7 @@ class Seq2SeqLSTMDetector(_TagMetricsMixin):
                 )
 
             self._score_jit = score
-        return np.asarray(self._score_jit(self.params, jnp.asarray(Xs)))
+        return np.asarray(self._score_jit(self._params_dev, jnp.asarray(Xs)))
 
     def predict(self, X: np.ndarray, names: Iterable[str],
                 meta: Optional[Dict] = None) -> np.ndarray:
@@ -509,9 +526,11 @@ class Seq2SeqLSTMDetector(_TagMetricsMixin):
         d = dict(self.__dict__)
         d.pop("_tls_obj", None)
         d.pop("_score_jit", None)
+        d.pop("_params_dev", None)
         return d
 
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._tls_obj = threading.local()
         self._score_jit = None
+        self._params_dev = None

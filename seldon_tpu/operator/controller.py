@@ -43,6 +43,7 @@ class ControllerLoop:
         self.reconciler = Reconciler(store, istio_enabled=istio_enabled)
         self._stop = threading.Event()
         self.reconcile_count = 0
+        self._list_rv = ""
 
     def stop(self) -> None:
         self._stop.set()
@@ -68,8 +69,15 @@ class ControllerLoop:
         return status
 
     def resync(self) -> int:
-        """Full list + reconcile; returns number of objects handled."""
-        objs = self.store.list("SeldonDeployment", self.namespace)
+        """Full list + reconcile; returns number of objects handled.
+        Remembers the list's resourceVersion so the following watch
+        starts after it (no synthetic ADDED replay)."""
+        lister = getattr(self.store, "list_with_version", None)
+        if lister is not None:
+            objs, self._list_rv = lister("SeldonDeployment", self.namespace)
+        else:
+            objs = self.store.list("SeldonDeployment", self.namespace)
+            self._list_rv = ""
         for obj in objs:
             self.reconcile_object(obj)
         return len(objs)
@@ -85,6 +93,7 @@ class ControllerLoop:
                 # instead of blocking in a long read.
                 for event in self.store.watch(
                     "SeldonDeployment", self.namespace,
+                    resource_version=self._list_rv,
                     timeout_s=self.resync_s,
                 ):
                     if self._stop.is_set():

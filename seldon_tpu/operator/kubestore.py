@@ -61,17 +61,23 @@ class KubeStore:
         import requests
 
         self.session = session or requests.Session()
+        cert = None
         if base_url is None:
-            base_url, token, verify = self._resolve_config(token, verify)
+            base_url, token, verify, cert = self._resolve_config(
+                token, verify
+            )
         self.base_url = base_url.rstrip("/")
         if token:
             self.session.headers["Authorization"] = f"Bearer {token}"
+        if cert is not None:
+            self.session.cert = cert
         if verify is not None:
             self.session.verify = verify
 
     @staticmethod
     def _resolve_config(token, verify):
-        """In-cluster service account, else kubeconfig."""
+        """In-cluster service account, else kubeconfig.
+        Returns (base_url, token, verify, client_cert_pair)."""
         token_path = os.path.join(SA_DIR, "token")
         if os.path.exists(token_path):
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
@@ -80,7 +86,7 @@ class KubeStore:
                 token = token or f.read().strip()
             ca = os.path.join(SA_DIR, "ca.crt")
             return (f"https://{host}:{port}", token,
-                    ca if os.path.exists(ca) else verify)
+                    ca if os.path.exists(ca) else verify, None)
         import yaml
 
         path = os.environ.get("KUBECONFIG",
@@ -98,7 +104,17 @@ class KubeStore:
         verify = cluster.get("certificate-authority",
                              not cluster.get("insecure-skip-tls-verify",
                                              False))
-        return cluster["server"], token, verify
+        cert = user.get("client-certificate")
+        key = user.get("client-key")
+        if not token and not (cert and key):
+            raise RuntimeError(
+                "kubeconfig user has neither a token nor client-certificate/"
+                "client-key; embedded *-data credentials are not supported — "
+                "use file paths or a token"
+            )
+        return cluster["server"], token, verify, (
+            (cert, key) if cert and key else None
+        )
 
     # -- plumbing ------------------------------------------------------------
 
@@ -134,6 +150,14 @@ class KubeStore:
         rv = existing.get("metadata", {}).get("resourceVersion")
         if rv:
             manifest["metadata"]["resourceVersion"] = rv
+        # Workloads whose manifest omits spec.replicas (HPA owns scaling)
+        # must keep the LIVE count: a PUT with nil replicas would let the
+        # apiserver default it to 1, stomping the autoscaler every resync.
+        if kind in ("Deployment", "StatefulSet"):
+            spec = manifest.get("spec", {})
+            live = existing.get("spec", {}).get("replicas")
+            if "replicas" not in spec and live is not None:
+                manifest["spec"] = dict(spec, replicas=live)
         self._req("PUT", url, json=manifest)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -145,6 +169,16 @@ class KubeStore:
 
     def list(self, kind: str, namespace: str,
              label_selector: Optional[Dict[str, str]] = None) -> List[Dict]:
+        items, _ = self.list_with_version(kind, namespace, label_selector)
+        return items
+
+    def list_with_version(
+        self, kind: str, namespace: str,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Dict], str]:
+        """(items, list resourceVersion) — feed the version into watch()
+        so the stream starts AFTER this list instead of replaying ADDED
+        for every existing object."""
         params = {}
         if label_selector:
             params["labelSelector"] = ",".join(
@@ -154,7 +188,7 @@ class KubeStore:
         items = out.get("items", [])
         for item in items:  # list items omit kind/apiVersion in k8s
             item.setdefault("kind", kind)
-        return items
+        return items, out.get("metadata", {}).get("resourceVersion", "")
 
     def is_ready(self, kind: str, namespace: str, name: str) -> bool:
         try:

@@ -435,7 +435,8 @@ def build_hpa_manifest(sdep: T.SeldonDeployment,
     serving pod come from the engine's req/s via custom metrics when
     configured."""
     dep_name = T.predictor_deployment_name(sdep, pred)
-    hpa = pred.hpa or T.HpaSpec()
+    hpa = pred.hpa
+    assert hpa is not None, "build_hpa_manifest requires pred.hpa"
     metrics = hpa.metrics or [
         {
             "type": "Resource",

@@ -165,6 +165,8 @@ class PredictorExt:
             out["tpu"] = self.tpu.to_dict()
         if self.component_images:
             out["componentImages"] = self.component_images
+        if self.resources:
+            out["resources"] = self.resources
         if self.hpa is not None:
             out["hpaSpec"] = self.hpa.to_dict()
         if self.explainer is not None:

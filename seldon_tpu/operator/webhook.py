@@ -131,4 +131,13 @@ def validate_deployment(sdep: T.SeldonDeployment) -> List[str]:
                     f"predictor {pred.spec.name!r}: multi-host tpu requires "
                     "an explicit topology"
                 )
+        if pred.hpa is not None and pred.tpu.hosts > 1:
+            # An HPA scales pods one at a time, but a slice is only valid
+            # in multiples of tpu.hosts — a partial slice never becomes
+            # ready. Reject rather than flap.
+            problems.append(
+                f"predictor {pred.spec.name!r}: hpaSpec is not supported on "
+                f"multi-host tpu predictors (slices scale in units of "
+                f"{pred.tpu.hosts} hosts)"
+            )
     return problems

@@ -60,6 +60,7 @@ class JAXServer(SeldonComponent):
         self.engine: Optional[InferenceEngine] = None
         self.cfg: Optional[ModelConfig] = None
         self._tracer = tracing.get_tracer("jaxserver")
+        self._slice_ready = None  # set by load() (SliceReadiness)
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -72,6 +73,13 @@ class JAXServer(SeldonComponent):
             from seldon_tpu.models import transformer
             from seldon_tpu.parallel import MeshPlan, make_mesh
             from seldon_tpu.parallel import sharding as shd
+            from seldon_tpu.parallel import distributed
+
+            # Multi-host slice: join via the StatefulSet env the operator
+            # injects (no-op single-host). Must happen before any backend
+            # query — jax.devices() is global after initialize.
+            distributed.ensure_initialized()
+            self._slice_ready = distributed.SliceReadiness()
 
             if self.model_uri:
                 from seldon_tpu.servers import checkpoint as ckpt
@@ -160,6 +168,10 @@ class JAXServer(SeldonComponent):
 
     def health_status(self):
         self._ensure_loaded()
+        # Slice-aware readiness: a multi-host pod is not ready until the
+        # whole slice has formed (raises -> wrapper /ready returns 503).
+        if self._slice_ready is not None:
+            self._slice_ready.check()
         return {"engine": self.engine.stats.snapshot()}
 
     def init_metadata(self) -> Dict:

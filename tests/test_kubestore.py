@@ -57,7 +57,10 @@ class FakeKubeApi:
                 if sel and not self._matches(obj, sel):
                     continue
                 items.append(obj)
-            return web.json_response({"items": items})
+            return web.json_response(
+                {"items": items,
+                 "metadata": {"resourceVersion": str(self.rv)}}
+            )
 
         key = self._key(prefix, plural, ns, name)
         if request.method == "GET":
