@@ -1,0 +1,148 @@
+"""Load tester — the reference's locust driver, TPU-build edition.
+
+Reference: util/loadtester/scripts/predict_rest_locust.py:1-157 (+ the
+master/slave helm chart). One asyncio process with N closed-loop clients
+replaces the locust cluster: an event loop sustains tens of thousands of
+in-flight HTTP requests, and the serving side is the bottleneck long
+before the driver is.
+
+  python -m seldon_tpu.loadtester http://host:8000 \
+      --clients 64 --seconds 30 --transport rest \
+      [--payload '{"data":{"ndarray":[[1.0]]}}'] [--grpc-host host:5001]
+
+Prints one JSON line: req/s, error count, p50/p90/p99 latency — the same
+shape bench_orchestrator.py reports, so numbers are directly comparable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+async def run_rest(url: str, payload: bytes, clients: int, seconds: float,
+                   path: str = "/api/v0.1/predictions"):
+    import aiohttp
+
+    stop_at = time.perf_counter() + seconds
+    latencies: List[float] = []
+    errors = [0]
+    full = url.rstrip("/") + path
+    headers = {"Content-Type": "application/json"}
+
+    async def worker(session):
+        n = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                async with session.post(full, data=payload,
+                                        headers=headers) as r:
+                    await r.read()
+                    if r.status != 200:
+                        errors[0] += 1
+                        continue
+            except Exception:
+                errors[0] += 1
+                continue
+            latencies.append(time.perf_counter() - t0)
+            n += 1
+        return n
+
+    conn = aiohttp.TCPConnector(limit=clients)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        t0 = time.perf_counter()
+        counts = await asyncio.gather(
+            *[worker(session) for _ in range(clients)]
+        )
+        dt = time.perf_counter() - t0
+    return sum(counts), dt, latencies, errors[0]
+
+
+async def run_grpc(target: str, payload_rows, clients: int, seconds: float):
+    import grpc.aio
+
+    from seldon_tpu.core import payloads as plib
+    from seldon_tpu.proto import prediction_grpc
+
+    channel = grpc.aio.insecure_channel(target)
+    stub = prediction_grpc.SeldonStub(channel)
+    req = plib.build_message(np.asarray(payload_rows, np.float32),
+                             kind="ndarray")
+    stop_at = time.perf_counter() + seconds
+    latencies: List[float] = []
+    errors = [0]
+
+    async def worker():
+        n = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                await stub.Predict(req)
+            except Exception:
+                errors[0] += 1
+                continue
+            latencies.append(time.perf_counter() - t0)
+            n += 1
+        return n
+
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*[worker() for _ in range(clients)])
+    dt = time.perf_counter() - t0
+    await channel.close()
+    return sum(counts), dt, latencies, errors[0]
+
+
+def report(transport: str, total: int, dt: float, latencies, errors: int,
+           clients: int) -> dict:
+    lats = np.asarray(latencies) * 1000.0 if latencies else np.zeros(1)
+    out = {
+        "metric": f"loadtest_{transport}_req_per_s",
+        "value": round(total / dt, 1) if dt else 0.0,
+        "unit": f"req/s ({clients} clients)",
+        "detail": {
+            "requests": total,
+            "errors": errors,
+            "p50_ms": round(float(np.percentile(lats, 50)), 2),
+            "p90_ms": round(float(np.percentile(lats, 90)), 2),
+            "p99_ms": round(float(np.percentile(lats, 99)), 2),
+        },
+    }
+    print(json.dumps(out))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description="seldon-tpu load tester")
+    parser.add_argument("url", help="engine base URL (http://host:port)")
+    parser.add_argument("--clients", type=int, default=64)
+    parser.add_argument("--seconds", type=float, default=30.0)
+    parser.add_argument("--transport", choices=["rest", "grpc"],
+                        default="rest")
+    parser.add_argument("--payload",
+                        default='{"data": {"ndarray": [[1.0, 2.0]]}}')
+    parser.add_argument("--grpc-host", default="",
+                        help="host:port for --transport grpc")
+    parser.add_argument("--path", default="/api/v0.1/predictions")
+    args = parser.parse_args(argv)
+
+    if args.transport == "rest":
+        total, dt, lats, errors = asyncio.run(
+            run_rest(args.url, args.payload.encode(), args.clients,
+                     args.seconds, args.path)
+        )
+    else:
+        rows = json.loads(args.payload)["data"]["ndarray"]
+        target = args.grpc_host or args.url.replace("http://", "")
+        total, dt, lats, errors = asyncio.run(
+            run_grpc(target, rows, args.clients, args.seconds)
+        )
+    report(args.transport, total, dt, lats, errors, args.clients)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

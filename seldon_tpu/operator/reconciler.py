@@ -195,6 +195,12 @@ def _engine_container(sdep: T.SeldonDeployment, pred: T.PredictorExt) -> Dict:
             {"containerPort": T.ENGINE_HTTP_PORT, "name": "rest"},
             {"containerPort": T.ENGINE_GRPC_PORT, "name": "grpc"},
         ],
+        # Downward-API podinfo: CR annotations reach the engine at runtime
+        # (timeouts/retries/grpc caps — core/annotations.py; reference
+        # seldondeployment_controller.go:627-633 + AnnotationsConfig.java).
+        "volumeMounts": [
+            {"name": "podinfo", "mountPath": "/etc/podinfo", "readOnly": True}
+        ],
         "readinessProbe": {
             "httpGet": {"path": "/ready", "port": T.ENGINE_HTTP_PORT},
             "initialDelaySeconds": 5,
@@ -250,9 +256,21 @@ def build_predictor_manifests(
     engine_labels = dict(labels)
     engine_labels[ENGINE_LABEL] = "true"
 
+    podinfo_volume = {
+        "name": "podinfo",
+        "downwardAPI": {
+            "items": [
+                {"path": "annotations",
+                 "fieldRef": {"fieldPath": "metadata.annotations"}}
+            ]
+        },
+    }
+
     pod_spec: Dict[str, Any] = {"containers": list(containers)}
     if init_containers:
         pod_spec["initContainers"] = init_containers
+    if not separate_engine:
+        volumes = volumes + [podinfo_volume]
     if volumes:
         pod_spec["volumes"] = volumes
     if pred.tpu.chips:
@@ -289,7 +307,12 @@ def build_predictor_manifests(
             "template": {
                 "metadata": {
                     "labels": {"app": dep_name, **pod_labels},
+                    # CR annotations ride the pod template so the downward
+                    # API exposes them at /etc/podinfo/annotations for the
+                    # engine's runtime knobs (core/annotations.py) — the
+                    # reference copies deployment annotations the same way.
                     "annotations": {
+                        **sdep.annotations,
                         "prometheus.io/scrape": "true",
                         "prometheus.io/path": "/prometheus",
                         "prometheus.io/port": str(T.ENGINE_HTTP_PORT),
@@ -366,9 +389,12 @@ def build_predictor_manifests(
                     "selector": {"matchLabels": {"app": engine_dep_name}},
                     "template": {
                         "metadata": {
-                            "labels": {"app": engine_dep_name, **engine_labels}
+                            "labels": {"app": engine_dep_name,
+                                       **engine_labels},
+                            "annotations": dict(sdep.annotations),
                         },
-                        "spec": {"containers": [engine]},
+                        "spec": {"containers": [engine],
+                                 "volumes": [podinfo_volume]},
                     },
                 },
             }

@@ -17,6 +17,7 @@ import grpc.aio
 from aiohttp import web
 
 from seldon_tpu.core import payloads, tracing
+from seldon_tpu.core.annotations import AnnotationsConfig
 from seldon_tpu.core.http import PROTO_CONTENT_TYPE, parse_message, reply
 from seldon_tpu.orchestrator.batcher import MicroBatcher
 from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
@@ -83,8 +84,17 @@ class EngineServer:
         self.metrics = metrics or get_default_metrics()
         self.reqlogger = RequestLogger(predictor=self.spec.name)
         self.batcher = MicroBatcher() if enable_batching else None
+        # Runtime knobs from CR annotations via the downward-API podinfo
+        # mount (reference AnnotationsConfig.java; no-op outside a pod).
+        self.annotations = AnnotationsConfig()
+        self.grpc_max_msg = self.annotations.grpc_max_msg_bytes()
         self.engine = PredictorEngine(
             self.spec,
+            client=InternalClient(
+                timeout_s=self.annotations.rest_timeout_s(30000),
+                retries=self.annotations.connect_retries(3),
+                max_message_bytes=self.grpc_max_msg,
+            ),
             batcher=self.batcher,
             metrics_hook=self._on_custom_metric,
         )
@@ -215,8 +225,8 @@ class EngineServer:
 
         self._grpc_server = grpc.aio.server(
             options=[
-                ("grpc.max_send_message_length", 512 * 1024 * 1024),
-                ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                ("grpc.max_send_message_length", self.grpc_max_msg),
+                ("grpc.max_receive_message_length", self.grpc_max_msg),
             ]
         )
         prediction_grpc.add_servicer(

@@ -1,0 +1,242 @@
+"""User-model packaging: the s2i-equivalent build layer (L6).
+
+Reference: `wrappers/s2i/python/` — s2i builder images whose `run` script
+execs `seldon-core-microservice $MODEL_NAME $API_TYPE --service-type
+$SERVICE_TYPE --persistence $PERSISTENCE` (s2i/bin/run:11-20).
+
+TPU-native redesign: s2i is an OpenShift-era tool; the modern equivalent
+is a generated Dockerfile + entrypoint over a plain model directory. The
+env-var contract is IDENTICAL (MODEL_NAME / API_TYPE / SERVICE_TYPE /
+PERSISTENCE), so CRs and docs written for the reference port unchanged.
+TPU images additionally need the libtpu base and the JAX cache warmup
+hook, which `generate_dockerfile(tpu=True)` wires in.
+
+CLI:  python -m seldon_tpu.packaging <model_dir> --model-name MyModel \
+          [--service-type MODEL] [--api-type REST,GRPC] [--tpu] [--build]
+
+Also here: graph TEMPLATES (L7 helm-chart equivalents of
+seldon-single-model / seldon-abtest / seldon-mab) rendered straight to
+SeldonDeployment dicts — `render_template("abtest", ...)`.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional
+
+ENTRYPOINT = """\
+#!/bin/sh -e
+# seldon-tpu microservice entrypoint (env contract mirrors the reference
+# s2i run script: wrappers/s2i/python/s2i/bin/run:11-20).
+if [ -z "$MODEL_NAME" ] || [ -z "$SERVICE_TYPE" ]; then
+    echo "Failed to find required env vars MODEL_NAME, SERVICE_TYPE" >&2
+    exit 1
+fi
+cd /microservice
+echo "starting seldon-tpu microservice"
+exec python -m seldon_tpu.runtime.microservice "$MODEL_NAME" \\
+    --api-type "${API_TYPE:-REST,GRPC}" \\
+    --service-type "$SERVICE_TYPE" \\
+    --persistence "${PERSISTENCE:-0}" \\
+    --tracing "${TRACING:-0}"
+"""
+
+
+def generate_entrypoint() -> str:
+    return ENTRYPOINT
+
+
+def generate_dockerfile(
+    base_image: str = "python:3.12-slim",
+    tpu: bool = False,
+    requirements: bool = True,
+) -> str:
+    """Dockerfile text for a user model directory. The build context must
+    contain the user's model module(s) (and optionally requirements.txt);
+    seldon_tpu itself is baked into the base image or installed here."""
+    if tpu:
+        base_image = "us-docker.pkg.dev/cloud-tpu-images/jax/tpu:latest"
+    lines = [
+        f"FROM {base_image}",
+        "WORKDIR /microservice",
+        "COPY . /microservice",
+    ]
+    if requirements:
+        lines += [
+            "RUN if [ -f requirements.txt ]; then "
+            "pip install --no-cache-dir -r requirements.txt; fi",
+        ]
+    if not tpu:
+        lines += ["RUN pip install --no-cache-dir jax[cpu]"]
+    lines += [
+        "RUN pip install --no-cache-dir seldon-tpu",
+        "COPY .seldon-tpu/run /run.sh",
+        "RUN chmod +x /run.sh",
+        "EXPOSE 9000 9500",
+        'ENV PREDICTIVE_UNIT_SERVICE_PORT=9000',
+        'CMD ["/run.sh"]',
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def package_model(
+    model_dir: str,
+    model_name: str,
+    service_type: str = "MODEL",
+    api_type: str = "REST,GRPC",
+    tpu: bool = False,
+    image_tag: Optional[str] = None,
+    build: bool = False,
+) -> Dict[str, str]:
+    """Write .seldon-tpu/{Dockerfile,run} into `model_dir`; optionally
+    `docker build`. Returns the generated file paths."""
+    out_dir = os.path.join(model_dir, ".seldon-tpu")
+    os.makedirs(out_dir, exist_ok=True)
+    run_path = os.path.join(out_dir, "run")
+    with open(run_path, "w") as f:
+        f.write(generate_entrypoint())
+    os.chmod(run_path, 0o755)
+    dockerfile_path = os.path.join(out_dir, "Dockerfile")
+    with open(dockerfile_path, "w") as f:
+        f.write(generate_dockerfile(tpu=tpu))
+    env_path = os.path.join(out_dir, "environment")
+    with open(env_path, "w") as f:
+        f.write(
+            f"MODEL_NAME={model_name}\n"
+            f"SERVICE_TYPE={service_type}\n"
+            f"API_TYPE={api_type}\n"
+            "PERSISTENCE=0\n"
+        )
+    result = {"dockerfile": dockerfile_path, "run": run_path,
+              "environment": env_path}
+    if build:
+        if shutil.which("docker") is None:
+            raise RuntimeError("docker not available for --build")
+        tag = image_tag or f"seldon-tpu-model/{model_name.lower()}:latest"
+        subprocess.run(
+            ["docker", "build", "-f", dockerfile_path, "-t", tag, model_dir],
+            check=True,
+        )
+        result["image"] = tag
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Graph templates (helm-chart equivalents)
+# ---------------------------------------------------------------------------
+
+
+def _unit(name: str, implementation: str = "", model_uri: str = "",
+          image: str = "", type_: str = "MODEL",
+          children: Optional[List[Dict]] = None) -> Dict:
+    unit: Dict = {"name": name, "type": type_}
+    if implementation:
+        unit["implementation"] = implementation
+    if model_uri:
+        unit["modelUri"] = model_uri
+    if children:
+        unit["children"] = children
+    return unit
+
+
+def render_template(template: str, name: str, namespace: str = "default",
+                    **kw) -> Dict:
+    """SeldonDeployment dict for a named graph template.
+
+    Templates (reference helm-charts/):
+      single-model  (seldon-single-model): one MODEL
+          kw: model_uri, implementation=JAX_SERVER, replicas=1, tpu=None
+      abtest        (seldon-abtest): RANDOM_ABTEST router over two models
+          kw: model_uri_a, model_uri_b, traffic split is router-level
+      mab           (seldon-mab): EpsilonGreedy router over two models
+          kw: model_uri_a, model_uri_b, epsilon=0.1
+      outlier-transformer (seldon-od-transformer): detector TRANSFORMER
+          in front of a model
+          kw: model_uri, detector_class (e.g. seldon_tpu.components.
+          VAEDetector), detector_uri
+    """
+    if template == "single-model":
+        graph = _unit(
+            "model",
+            implementation=kw.get("implementation", "JAX_SERVER"),
+            model_uri=kw.get("model_uri", ""),
+        )
+        predictor: Dict = {
+            "name": "default",
+            "replicas": int(kw.get("replicas", 1)),
+            "graph": graph,
+        }
+        if kw.get("tpu"):
+            predictor["tpu"] = kw["tpu"]
+        predictors = [predictor]
+    elif template in ("abtest", "mab"):
+        children = [
+            _unit("model-a", implementation=kw.get("implementation", "JAX_SERVER"),
+                  model_uri=kw.get("model_uri_a", "")),
+            _unit("model-b", implementation=kw.get("implementation", "JAX_SERVER"),
+                  model_uri=kw.get("model_uri_b", "")),
+        ]
+        if template == "abtest":
+            router = _unit("ab-router", implementation="RANDOM_ABTEST",
+                           type_="ROUTER", children=children)
+            router["parameters"] = [
+                {"name": "ratioA", "value": str(kw.get("ratio_a", 0.5)),
+                 "type": "FLOAT"}
+            ]
+        else:
+            router = _unit("eg-router", type_="ROUTER", children=children)
+            router["image"] = kw.get(
+                "router_image", "seldon-tpu/microservice:0.1.0"
+            )
+            router["parameters"] = [
+                {"name": "n_branches", "value": "2", "type": "INT"},
+                {"name": "epsilon",
+                 "value": str(kw.get("epsilon", 0.1)), "type": "FLOAT"},
+            ]
+        predictors = [{"name": "default", "replicas": 1, "graph": router}]
+    elif template == "outlier-transformer":
+        model = _unit("model", implementation=kw.get("implementation", "JAX_SERVER"),
+                      model_uri=kw.get("model_uri", ""))
+        det = _unit("outlier-detector", type_="TRANSFORMER",
+                    model_uri=kw.get("detector_uri", ""),
+                    children=[model])
+        det["image"] = kw.get("detector_image",
+                              "seldon-tpu/microservice:0.1.0")
+        predictors = [{"name": "default", "replicas": 1, "graph": det}]
+    else:
+        raise ValueError(
+            f"unknown template {template!r}; have single-model, abtest, "
+            "mab, outlier-transformer"
+        )
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"name": name, "predictors": predictors},
+    }
+
+
+def main(argv=None) -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    parser = argparse.ArgumentParser(description="package a seldon-tpu model")
+    parser.add_argument("model_dir")
+    parser.add_argument("--model-name", required=True)
+    parser.add_argument("--service-type", default="MODEL")
+    parser.add_argument("--api-type", default="REST,GRPC")
+    parser.add_argument("--tpu", action="store_true")
+    parser.add_argument("--build", action="store_true")
+    parser.add_argument("--image-tag", default=None)
+    args = parser.parse_args(argv)
+    out = package_model(
+        args.model_dir, args.model_name, args.service_type, args.api_type,
+        tpu=args.tpu, image_tag=args.image_tag, build=args.build,
+    )
+    for k, v in out.items():
+        print(f"{k}: {v}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

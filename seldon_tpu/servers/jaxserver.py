@@ -167,11 +167,15 @@ class JAXServer(SeldonComponent):
             self.load()
 
     def health_status(self):
-        self._ensure_loaded()
-        # Slice-aware readiness: a multi-host pod is not ready until the
-        # whole slice has formed (raises -> wrapper /ready returns 503).
+        # Probes must NEVER block on (or trigger) load: during multi-host
+        # slice formation, load() sits inside jax.distributed.initialize
+        # holding the load lock — a probe that joined it would hang until
+        # kubelet's timeout instead of returning a crisp 503. Not loaded
+        # (including "waiting for slice peers") IS not-ready.
+        if not self._loaded:
+            raise RuntimeError("model loading (or slice forming)")
         if self._slice_ready is not None:
-            self._slice_ready.check()
+            self._slice_ready.check()  # local accelerator sanity
         return {"engine": self.engine.stats.snapshot()}
 
     def init_metadata(self) -> Dict:

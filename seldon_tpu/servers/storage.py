@@ -2,9 +2,12 @@
 and the kfserving model-initializer initContainer,
 operator/controllers/model_initializer_injector.go:65-228).
 
-Supported URIs: local paths and file:// always; gs:// via google.cloud.storage
-and s3:// via boto3/minio only if those clients exist in the image (they are
-not baked in — gated, with a clear error instead of an import crash)."""
+Supported URIs: local paths and file:// always; https:// (direct file
+fetch) and azure:// / https://*.blob.core.windows.net (Azure Blob REST,
+anonymous or SAS — no SDK needed) always; gs:// via google.cloud.storage
+and s3:// via boto3/minio only if those clients exist in the image (they
+are not baked in — gated, with a clear error instead of an import
+crash)."""
 
 from __future__ import annotations
 
@@ -24,6 +27,10 @@ def download(uri: str, out_dir: str | None = None) -> str:
         return _download_gcs(uri, out_dir or _uri_dir(uri))
     if uri.startswith("s3://"):
         return _download_s3(uri, out_dir or _uri_dir(uri))
+    if uri.startswith("azure://") or ".blob.core.windows.net" in uri:
+        return _download_azure_blob(uri, out_dir or _uri_dir(uri))
+    if uri.startswith(("http://", "https://")):
+        return _download_http(uri, out_dir or _uri_dir(uri))
     if os.path.exists(uri):
         return uri
     raise ValueError(f"unsupported or missing model uri: {uri!r}")
@@ -78,6 +85,85 @@ def _download_gcs(uri: str, out_dir: str | None) -> str:
         dst = os.path.join(target, rel)
         os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
         blob.download_to_filename(dst)
+    return target
+
+
+def _download_http(uri: str, out_dir: str | None) -> str:
+    """Plain https file fetch (reference storage.py supports URL models)."""
+    import requests
+
+    target = _target_dir(out_dir)
+    name = os.path.basename(uri.split("?", 1)[0]) or "model"
+    dst = os.path.join(target, name)
+    with requests.get(uri, stream=True, timeout=300) as r:
+        r.raise_for_status()
+        with open(dst, "wb") as f:
+            for chunk in r.iter_content(1 << 20):
+                f.write(chunk)
+    return target
+
+
+def _download_azure_blob(uri: str, out_dir: str | None) -> str:
+    """Azure Blob container prefix download over the raw REST API
+    (reference python/seldon_core/storage.py azure path used the SDK; the
+    List Blobs + GET endpoints need none for anonymous/SAS access).
+
+    Accepts `azure://account/container/prefix` or
+    `https://account.blob.core.windows.net/container/prefix[?sas]`.
+    A SAS token can ride the URI query or env AZURE_SAS_TOKEN."""
+    import re as _re
+    import xml.etree.ElementTree as ET
+
+    import requests
+
+    query = ""
+    if uri.startswith("azure://"):
+        rest = uri[len("azure://"):]
+        rest, _, query = rest.partition("?")  # SAS may ride azure:// too
+        account, _, tail = rest.partition("/")
+        base = f"https://{account}.blob.core.windows.net"
+    else:
+        m = _re.match(r"(https?://[^/]+)/(.*)$", uri)
+        if m is None:
+            raise ValueError(f"unparseable blob uri: {uri!r}")
+        base, tail = m.group(1), m.group(2)
+        tail, _, query = tail.partition("?")
+    container, _, prefix = tail.partition("/")
+    sas = query or os.environ.get("AZURE_SAS_TOKEN", "").lstrip("?")
+
+    def with_sas(url: str, extra: str = "") -> str:
+        parts = [p for p in (extra, sas) if p]
+        return url + ("?" + "&".join(parts) if parts else "")
+
+    target = _target_dir(out_dir)
+    names: list[str] = []
+    marker = ""
+    while True:  # List Blobs pages at 5000 entries (NextMarker)
+        extra = f"restype=container&comp=list&prefix={prefix}"
+        if marker:
+            extra += f"&marker={marker}"
+        r = requests.get(with_sas(f"{base}/{container}", extra), timeout=60)
+        r.raise_for_status()
+        root = ET.fromstring(r.content)
+        names.extend(b.findtext("Name") for b in root.iter("Blob"))
+        marker = root.findtext("NextMarker") or ""
+        if not marker:
+            break
+    if not names:
+        raise ValueError(f"no blobs under {uri!r}")
+    for name in names:
+        rel = _relative_key(name, prefix)
+        if rel is None:
+            continue
+        dst = os.path.join(target, rel)
+        os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
+        blob = requests.get(
+            with_sas(f"{base}/{container}/{name}"), timeout=300, stream=True
+        )
+        blob.raise_for_status()
+        with open(dst, "wb") as f:
+            for chunk in blob.iter_content(1 << 20):
+                f.write(chunk)
     return target
 
 

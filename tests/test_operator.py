@@ -284,7 +284,8 @@ def test_two_prepackaged_units_get_separate_volumes():
     Reconciler(store).reconcile(sdep)
     pod = store.list("Deployment", "test")[0]["spec"]["template"]["spec"]
     vols = {v["name"] for v in pod["volumes"]}
-    assert len(vols) == 2  # one per unit, no clobbering
+    # one model volume per unit (no clobbering) + the engine's podinfo
+    assert vols == {"model-volume-top", "model-volume-leaf", "podinfo"}
     for c in pod["containers"]:
         if c["name"] in ("top", "leaf"):
             assert c["volumeMounts"][0]["name"] == f"model-volume-{c['name']}"
@@ -415,3 +416,18 @@ def test_explainer_gc_with_generation():
     rec.reconcile(fixture_cr(predictors=[pred2], generation=2))
     names = {d["metadata"]["name"] for d in store.list("Deployment", "test")}
     assert not any("explainer" in n for n in names), names
+
+
+def test_cr_annotations_reach_pod_template_for_podinfo():
+    """CR annotations must land on the pod template: the engine reads them
+    back via the downward-API podinfo mount (core/annotations.py)."""
+    sdep = fixture_cr()
+    sdep.annotations["seldon.io/rest-read-timeout"] = "9000"
+    store = InMemoryStore()
+    Reconciler(store, istio_enabled=False).reconcile(sdep)
+    pod_meta = store.list("Deployment", "test")[0]["spec"]["template"]["metadata"]
+    assert pod_meta["annotations"]["seldon.io/rest-read-timeout"] == "9000"
+    vols = {v["name"]: v for v in
+            store.list("Deployment", "test")[0]["spec"]["template"]["spec"]["volumes"]}
+    items = vols["podinfo"]["downwardAPI"]["items"]
+    assert items[0]["fieldRef"]["fieldPath"] == "metadata.annotations"

@@ -219,3 +219,28 @@ class TestRound2ReviewFindings:
         out = asyncio.run(b.call(unit, m, FakeClient()))
         assert b.stats["direct_calls"] == 1 and b.stats["fused_calls"] == 0
         assert out.meta.tags["batch_index"].string_value == "deadbeef"
+
+
+class TestSamplerMaskedTail:
+    def test_masked_final_tokens_never_sampled(self):
+        """Inverse-CDF sampling must not leak residual probability mass to
+        masked trailing vocab entries (fp32 cumsum error + clamp bug)."""
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_tpu.models.sampling import sample_per_row
+
+        B, V = 64, 32000
+        # Top-k=2 over a peaked distribution: only tokens {0, 1} legal.
+        logits = jnp.tile(
+            jnp.concatenate([jnp.array([5.0, 4.0]), jnp.zeros(V - 2)]),
+            (B, 1),
+        )
+        keys = jax.random.split(jax.random.key(0), B)
+        for trial in range(20):
+            keys = jax.vmap(jax.random.fold_in)(keys, jnp.full(B, trial))
+            toks = sample_per_row(
+                logits, keys,
+                jnp.ones(B), jnp.full(B, 2, jnp.int32), jnp.ones(B),
+            )
+            assert int(jnp.max(toks)) <= 1, int(jnp.max(toks))
