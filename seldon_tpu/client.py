@@ -129,6 +129,38 @@ class SeldonClient:
             return self._rest("/api/v0.1/predictions", request, pb.SeldonMessage)
         return self._grpc_call("Seldon", "Predict", request, pb.SeldonMessage)
 
+    def explain(self, data=None, names=None, payload_kind="dense",
+                msg=None, explainer_host: str = "",
+                gateway_prefix: str = "") -> ClientResponse:
+        """Attributions from the predictor's `-explainer` deployment
+        (reference seldon_client.explain). Address the explainer one of
+        two ways: `explainer_host` (direct host:port of the explainer
+        service) or `gateway_prefix` (ingress prefix, e.g.
+        `/seldon/ns/name-explainer/pred` — the istio route rewrites it
+        onto the explainer's /predict)."""
+        request = self._build_request(data, payload_kind, names, msg)
+        if explainer_host:
+            import requests as _rq
+
+            r = _rq.post(
+                f"http://{explainer_host}/predict",
+                json=payloads.message_to_dict(request),
+                timeout=self.timeout_s,
+            )
+            r.raise_for_status()
+            return ClientResponse(
+                True, payloads.dict_to_message(r.json()), r.json()
+            )
+        if not gateway_prefix:
+            raise ValueError(
+                "explain() needs explainer_host (direct) or gateway_prefix "
+                "(ingress route) — the engine itself serves no /explain"
+            )
+        return self._rest(
+            f"{gateway_prefix.rstrip('/')}/predict", request,
+            pb.SeldonMessage,
+        )
+
     def feedback(self, request_msg=None, response_msg=None, reward=0.0,
                  truth=None) -> ClientResponse:
         fb = pb.Feedback(reward=float(reward))

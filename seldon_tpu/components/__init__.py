@@ -14,6 +14,11 @@ from seldon_tpu.components.outliers_learned import (
     Seq2SeqLSTMDetector,
     VAEDetector,
 )
+from seldon_tpu.components.explainers import (
+    ExplainerServer,
+    IntegratedGradients,
+    OcclusionExplainer,
+)
 
 __all__ = [
     "EpsilonGreedy",
@@ -23,4 +28,7 @@ __all__ = [
     "VAEDetector",
     "IsolationForestDetector",
     "Seq2SeqLSTMDetector",
+    "IntegratedGradients",
+    "OcclusionExplainer",
+    "ExplainerServer",
 ]

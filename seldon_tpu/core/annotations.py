@@ -44,9 +44,17 @@ def parse_downward_api(text: str) -> Dict[str, str]:
         raw = raw.strip()
         if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
             raw = raw[1:-1]
-            # Unescape the common Go escapes (\" \\ \n).
-            raw = (raw.replace('\\"', '"').replace("\\n", "\n")
-                      .replace("\\\\", "\\"))
+            # Unescape Go escapes in a SINGLE pass — sequential replaces
+            # corrupt values like 'C:\\network' (the \\ pair must not be
+            # re-read as the start of \n).
+            import re as _re
+
+            raw = _re.sub(
+                r"\\(.)",
+                lambda m: {"n": "\n", "t": "\t"}.get(m.group(1),
+                                                     m.group(1)),
+                raw,
+            )
         out[key.strip()] = raw
     return out
 

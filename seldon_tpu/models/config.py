@@ -46,7 +46,7 @@ class ModelConfig:
     def validate(self) -> "ModelConfig":
         assert self.d_model % self.n_heads == 0, "d_model must divide by n_heads"
         assert self.n_heads % self.n_kv_heads == 0, "n_heads must divide by n_kv_heads"
-        assert self.attn_impl in ("xla", "flash"), (
+        assert self.attn_impl in ("xla", "flash", "ring"), (
             f"unknown attn_impl {self.attn_impl!r}"
         )
         if self.n_experts:

@@ -57,16 +57,18 @@ MOE_AUX_WEIGHT = 0.01
 
 
 def loss_fn(params, tokens, loss_mask, cfg: ModelConfig, act_spec=None,
-            forward_fn=None):
+            forward_fn=None, ring_mesh=None):
     """Next-token CE (+ router load-balance aux for MoE configs).
     tokens [B,S]; loss_mask [B,S] (0 on pad/prompt).
-    forward_fn overrides the dense forward (pipeline-parallel path)."""
+    forward_fn overrides the dense forward (pipeline-parallel path);
+    ring_mesh activates ring attention (attn_impl == "ring")."""
     if forward_fn is not None:
         logits, aux = forward_fn(params, tokens)
     else:
         logits, aux = transformer.forward(params, tokens, cfg,
                                           act_spec=act_spec,
-                                          remat=True, return_aux=True)
+                                          remat=True, return_aux=True,
+                                          ring_mesh=ring_mesh)
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
@@ -142,10 +144,16 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig, optimizer,
 
     init_fn = jax.jit(_init, out_shardings=state_ns)
 
+    ring_mesh = (
+        mesh if (cfg.attn_impl == "ring" and mesh.shape.get("sp", 1) > 1)
+        else None
+    )
+
     def _step(state: TrainState, tokens, loss_mask):
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, tokens, loss_mask, cfg,
-            None if forward_fn is not None else act_spec, forward_fn
+            None if forward_fn is not None else act_spec, forward_fn,
+            ring_mesh,
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params

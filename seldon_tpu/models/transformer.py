@@ -182,6 +182,7 @@ def _block(
     write_pos: Optional[jnp.ndarray] = None,
     act_spec: Optional[P] = None,
     full_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = None,
+    ring_mesh=None,
 ):
     """One transformer block.
 
@@ -216,8 +217,25 @@ def _block(
     use_flash = cfg.attn_impl == "flash" and S > 1 and (
         window is None or S == window
     )
+    # Ring attention: long-context full-sequence path with the sequence
+    # axis sharded over 'sp' — exact attention, k/v blocks rotate over ICI
+    # (parallel/ring_attention.py). Cache-free only: scoring + training.
+    use_ring = (
+        cfg.attn_impl == "ring" and ring_mesh is not None and S > 1
+        and kv is None and full_cache is None
+    )
 
-    if use_flash:
+    if use_ring:
+        from seldon_tpu.parallel.ring_attention import ring_attention
+
+        G = cfg.q_per_kv
+        k_exp = jnp.repeat(k, G, axis=2)  # kv heads -> H for the ring
+        v_exp = jnp.repeat(v, G, axis=2)
+        out = ring_attention(q, k_exp, v_exp, ring_mesh, axis="sp",
+                             causal=True)
+        attn = out.reshape(B, S, cfg.n_heads * Dh)
+        new_kv = None
+    elif use_flash:
         # Full-sequence causal path through the pallas flash kernel
         # (ops/flash_attention.py). GQA is native in the kernel: kv stays
         # at Hkv heads and the q-head grid maps onto shared kv rows.
@@ -310,14 +328,14 @@ def _block(
 
 
 def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
-                write_pos=None, act_spec=None, remat=False):
+                write_pos=None, act_spec=None, remat=False, ring_mesh=None):
     """lax.scan over the stacked layer axis."""
 
     if cache is None:
 
         def body(carry, bp):
             out, _, aux = _block(carry, bp, cfg, positions, inv_freq, mask,
-                                 act_spec=act_spec)
+                                 act_spec=act_spec, ring_mesh=ring_mesh)
             return out, aux
 
         if remat:
@@ -373,10 +391,12 @@ def forward(
     act_spec: Optional[P] = None,
     remat: bool = False,
     return_aux: bool = False,
+    ring_mesh=None,
 ):
     """Full-sequence teacher-forced logits [B, S, V] (training / scoring).
     With return_aux=True also returns {"moe_lb_loss": scalar} (zero for
-    dense configs)."""
+    dense configs). `ring_mesh` activates ring attention over 'sp' when
+    cfg.attn_impl == "ring" (long-context path)."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)
     if act_spec is not None:
@@ -385,7 +405,8 @@ def forward(
     inv_freq = rope_frequencies(cfg)
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
     x, _, aux = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                            act_spec=act_spec, remat=remat)
+                            act_spec=act_spec, remat=remat,
+                            ring_mesh=ring_mesh)
     logits = _logits(params, x, cfg)
     if return_aux:
         return logits, {"moe_lb_loss": aux}

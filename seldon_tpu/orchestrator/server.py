@@ -215,11 +215,12 @@ class EngineServer:
         async def SendFeedback(self, request, context):
             return await self.outer.engine.send_feedback(request)
 
-    async def start(self, host: str = "0.0.0.0"):
+    async def start(self, host: str = "0.0.0.0", reuse_port: bool = False):
         app = self.build_app()
         self._runner = web.AppRunner(app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, self.http_port)
+        site = web.TCPSite(self._runner, host, self.http_port,
+                           reuse_port=reuse_port or None)
         await site.start()
         self.http_port = site._server.sockets[0].getsockname()[1]
 
@@ -227,6 +228,8 @@ class EngineServer:
             options=[
                 ("grpc.max_send_message_length", self.grpc_max_msg),
                 ("grpc.max_receive_message_length", self.grpc_max_msg),
+                # Worker processes share the port (kernel load-balanced).
+                ("grpc.so_reuseport", 1 if reuse_port else 0),
             ]
         )
         prediction_grpc.add_servicer(
@@ -250,28 +253,74 @@ class EngineServer:
         await self.engine.close()
 
 
+def _worker_main(http_port: int, grpc_port: int, enable_batching: bool,
+                 reuse_port: bool) -> None:
+    logging.basicConfig(level=logging.INFO)
+    server = EngineServer(
+        http_port=http_port, grpc_port=grpc_port,
+        enable_batching=enable_batching,
+    )
+
+    async def run():
+        await server.start(reuse_port=reuse_port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(run())
+
+
 def main():  # pragma: no cover - CLI entry
     import argparse
+    import os
 
     parser = argparse.ArgumentParser(description="seldon-tpu engine")
     parser.add_argument("--http-port", type=int, default=8000)
     parser.add_argument("--grpc-port", type=int, default=5001)
     parser.add_argument("--no-batching", action="store_true")
+    parser.add_argument(
+        "--workers", type=int,
+        default=int(os.environ.get("ENGINE_WORKERS", "1")),
+        help="event-loop processes sharing the ports via SO_REUSEPORT "
+             "(the asyncio engine is single-core; the reference's Java "
+             "engine used every core of its n1-standard-16)",
+    )
     args = parser.parse_args()
 
-    logging.basicConfig(level=logging.INFO)
-    server = EngineServer(
-        http_port=args.http_port,
-        grpc_port=args.grpc_port,
-        enable_batching=not args.no_batching,
-    )
+    if args.workers > 1:
+        import multiprocessing as mp
+        import signal
 
-    async def run():
-        await server.start()
-        while True:
-            await asyncio.sleep(3600)
+        procs = [
+            mp.Process(
+                target=_worker_main,
+                args=(args.http_port, args.grpc_port,
+                      not args.no_batching, True),
+                daemon=False,
+            )
+            for _ in range(args.workers)
+        ]
+        for p in procs:
+            p.start()
 
-    asyncio.run(run())
+        def shutdown(signum, frame):
+            # Propagate termination: otherwise SIGTERM (k8s pod stop)
+            # kills only the supervisor and orphans bound workers.
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+
+        signal.signal(signal.SIGTERM, shutdown)
+        signal.signal(signal.SIGINT, shutdown)
+        try:
+            for p in procs:
+                p.join()
+        finally:
+            shutdown(None, None)
+            for p in procs:
+                p.join(timeout=5)
+    else:
+        _worker_main(args.http_port, args.grpc_port,
+                     not args.no_batching, False)
 
 
 if __name__ == "__main__":  # pragma: no cover

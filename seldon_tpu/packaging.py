@@ -52,10 +52,14 @@ def generate_dockerfile(
     base_image: str = "python:3.12-slim",
     tpu: bool = False,
     requirements: bool = True,
+    env: Optional[Dict[str, str]] = None,
 ) -> str:
     """Dockerfile text for a user model directory. The build context must
     contain the user's model module(s) (and optionally requirements.txt);
-    seldon_tpu itself is baked into the base image or installed here."""
+    seldon_tpu itself is baked into the base image or installed here.
+    `env` (MODEL_NAME etc.) is baked in with ENV lines — the run script's
+    contract is env-driven, so without them the container exits at boot
+    (the reference s2i builder bakes its environment file the same way)."""
     if tpu:
         base_image = "us-docker.pkg.dev/cloud-tpu-images/jax/tpu:latest"
     lines = [
@@ -76,8 +80,10 @@ def generate_dockerfile(
         "RUN chmod +x /run.sh",
         "EXPOSE 9000 9500",
         'ENV PREDICTIVE_UNIT_SERVICE_PORT=9000',
-        'CMD ["/run.sh"]',
     ]
+    for k, v in (env or {}).items():
+        lines.append(f"ENV {k}={v}")
+    lines += ['CMD ["/run.sh"]']
     return "\n".join(lines) + "\n"
 
 
@@ -98,17 +104,18 @@ def package_model(
     with open(run_path, "w") as f:
         f.write(generate_entrypoint())
     os.chmod(run_path, 0o755)
+    env = {
+        "MODEL_NAME": model_name,
+        "SERVICE_TYPE": service_type,
+        "API_TYPE": api_type,
+        "PERSISTENCE": "0",
+    }
     dockerfile_path = os.path.join(out_dir, "Dockerfile")
     with open(dockerfile_path, "w") as f:
-        f.write(generate_dockerfile(tpu=tpu))
+        f.write(generate_dockerfile(tpu=tpu, env=env))
     env_path = os.path.join(out_dir, "environment")
     with open(env_path, "w") as f:
-        f.write(
-            f"MODEL_NAME={model_name}\n"
-            f"SERVICE_TYPE={service_type}\n"
-            f"API_TYPE={api_type}\n"
-            "PERSISTENCE=0\n"
-        )
+        f.write("".join(f"{k}={v}\n" for k, v in env.items()))
     result = {"dockerfile": dockerfile_path, "run": run_path,
               "environment": env_path}
     if build:

@@ -135,8 +135,16 @@ class JAXServer(SeldonComponent):
 
             from seldon_tpu.models import transformer as _tf
 
+            # Long-context scoring rides ring attention when the config
+            # asks for it and the serving mesh has a real 'sp' axis.
+            ring = (
+                mesh if (cfg.attn_impl == "ring"
+                         and dict(mesh.shape).get("sp", 1) > 1)
+                else None
+            )
+
             def _score(params, toks, *, _cfg):
-                logits = _tf.forward(params, toks, _cfg)
+                logits = _tf.forward(params, toks, _cfg, ring_mesh=ring)
                 lp = _jax.nn.log_softmax(
                     logits[:, :-1].astype(_jnp.float32), -1
                 )

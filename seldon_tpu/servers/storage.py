@@ -138,17 +138,24 @@ def _download_azure_blob(uri: str, out_dir: str | None) -> str:
     target = _target_dir(out_dir)
     names: list[str] = []
     marker = ""
-    while True:  # List Blobs pages at 5000 entries (NextMarker)
-        extra = f"restype=container&comp=list&prefix={prefix}"
-        if marker:
-            extra += f"&marker={marker}"
-        r = requests.get(with_sas(f"{base}/{container}", extra), timeout=60)
-        r.raise_for_status()
-        root = ET.fromstring(r.content)
-        names.extend(b.findtext("Name") for b in root.iter("Blob"))
-        marker = root.findtext("NextMarker") or ""
-        if not marker:
-            break
+    try:
+        while True:  # List Blobs pages at 5000 entries (NextMarker)
+            extra = f"restype=container&comp=list&prefix={prefix}"
+            if marker:
+                extra += f"&marker={marker}"
+            r = requests.get(
+                with_sas(f"{base}/{container}", extra), timeout=60
+            )
+            r.raise_for_status()
+            root = ET.fromstring(r.content)
+            names.extend(b.findtext("Name") for b in root.iter("Blob"))
+            marker = root.findtext("NextMarker") or ""
+            if not marker:
+                break
+    except requests.HTTPError:
+        # Single-blob URL with a read-only SAS (no list permission — the
+        # common single-file grant): fall back to a direct GET.
+        return _download_http(uri, out_dir)
     if not names:
         raise ValueError(f"no blobs under {uri!r}")
     for name in names:

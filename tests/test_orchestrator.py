@@ -4,6 +4,7 @@ against real in-process unit servers (fixed-output model trick from
 testing/docker/fixed-model)."""
 
 import asyncio
+import os
 import threading
 
 import numpy as np
@@ -416,3 +417,56 @@ def test_engine_server_rest_roundtrip():
     assert ready_status == 200
     assert paused_status == 503
     assert "engine" in prom or "seldon" in prom or prom  # prometheus text
+
+
+def test_multiworker_engine_shares_port():
+    """--workers N: worker processes share ports via SO_REUSEPORT and all
+    serve the graph (reference's Java engine used every core; the asyncio
+    engine scales with processes)."""
+    import json as _json
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        http_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        grpc_port = s.getsockname()[1]
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seldon_tpu.orchestrator.server",
+         "--workers", "2", "--http-port", str(http_port),
+         "--grpc-port", str(grpc_port), "--no-batching"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        body = _json.dumps({"data": {"ndarray": [[1.0]]}}).encode()
+        deadline = time.time() + 60
+        out = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http_port}/api/v0.1/predictions",
+                    data=body, headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    out = _json.loads(r.read())
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        proc.stdout.read().decode()[-2000:]
+                    )
+                time.sleep(0.3)
+        assert out is not None, "engine never came up"
+        # SIMPLE_MODEL fallback graph answered.
+        assert out["meta"]["requestPath"], out
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

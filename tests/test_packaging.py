@@ -112,8 +112,6 @@ def test_packaged_entrypoint_boots_microservice(tmp_path):
     ("outlier-transformer", {"model_uri": "gs://b/m"}),
 ])
 def test_templates_validate_and_reconcile(template, kw):
-    cr = render_template(template, name=f"t-{template}")
-    # strip the unsupported kwargs path: use defaults merged with kw
     cr = render_template(template, name=f"t-{template}", **kw)
     sdep = SeldonDeployment.from_dict(cr)
     store = InMemoryStore()
