@@ -32,7 +32,8 @@ SLOTS = int(os.environ.get("BENCH_SLOTS", 160))
 N_REQ = int(os.environ.get("BENCH_NREQ", 320))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", 128))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW", 128))
-DECODE_CHUNK = int(os.environ.get("BENCH_CHUNK", 32))
+DECODE_CHUNK = int(os.environ.get("BENCH_CHUNK", 64))  # 32 -> 0.78x, 64 -> 0.82x
+KV_DTYPE = os.environ.get("BENCH_KV", "bf16")
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
@@ -45,6 +46,10 @@ def main() -> None:
     from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
 
     cfg = get_config(PRESET)
+    if KV_DTYPE != "bf16":
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=KV_DTYPE)
     params = init_params(cfg, jax.random.key(0))
 
     ecfg = EngineConfig(
@@ -104,7 +109,8 @@ def main() -> None:
                 "value": round(req_s, 3),
                 "unit": (
                     f"req/s (engine, {SLOTS} slots, {N_REQ} concurrent, "
-                    f"prefill{PROMPT_LEN}+decode{NEW_TOKENS}, {PRESET} bf16)"
+                    f"prefill{PROMPT_LEN}+decode{NEW_TOKENS}, {PRESET} "
+                    f"bf16 weights, {KV_DTYPE} kv)"
                 ),
                 "vs_baseline": round(req_s / BASELINE_REQ_S_PER_CHIP, 3),
                 "detail": {

@@ -32,8 +32,13 @@ class ModelConfig:
     pad_token_id: int = 0
     # "xla" = einsum attention (GSPMD-shardable, default); "flash" = pallas
     # blockwise kernel on the full-sequence path (single-device / tp=1 —
-    # pallas ops don't auto-partition under GSPMD).
+    # pallas ops don't auto-partition under GSPMD); "ring" = exact
+    # sequence-parallel attention over the 'sp' mesh axis (long context).
     attn_impl: str = "xla"
+    # "bf16" (compute dtype) or "int8": per-(token, head) symmetric
+    # quantization of KV slots — halves the cache read per decode step,
+    # the serving bottleneck at high slot counts.
+    kv_cache_dtype: str = "bf16"
 
     @property
     def head_dim(self) -> int:
@@ -48,6 +53,9 @@ class ModelConfig:
         assert self.n_heads % self.n_kv_heads == 0, "n_heads must divide by n_kv_heads"
         assert self.attn_impl in ("xla", "flash", "ring"), (
             f"unknown attn_impl {self.attn_impl!r}"
+        )
+        assert self.kv_cache_dtype in ("bf16", "int8"), (
+            f"unknown kv_cache_dtype {self.kv_cache_dtype!r}"
         )
         if self.n_experts:
             assert self.n_experts_per_token <= self.n_experts

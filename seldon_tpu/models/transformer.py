@@ -171,6 +171,71 @@ def moe_block(x: jnp.ndarray, bp: Dict[str, jnp.ndarray], cfg: ModelConfig):
 # ---------------------------------------------------------------------------
 
 
+def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(token, head) symmetric int8: x [..., Dh] -> (int8 [..., Dh],
+    scale [...]). Halves KV-cache HBM traffic — the decode-step
+    bottleneck once weights are amortized over enough slots."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _write_cache(cache: Cache, li, k, v, write_pos, quantized: bool,
+                 whole_window: bool) -> Cache:
+    """Scatter fresh k/v (bf16 [B,S,Hkv,Dh]) into layer `li` of the full
+    carried token-major cache ([L,B,T,Hkv,...]). Quantized caches also
+    write the per-slot scales (same leading layout minus Dh)."""
+    if quantized:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    else:
+        writes = {"k": k.astype(cache["k"].dtype),
+                  "v": v.astype(cache["v"].dtype)}
+    out = dict(cache)
+    if whole_window:
+        for key, val in writes.items():
+            out[key] = jax.lax.dynamic_update_index_in_dim(
+                cache[key], val.astype(cache[key].dtype), li, 0
+            )
+        return out
+    B, S = k.shape[0], k.shape[1]
+    rows = jnp.arange(B)
+    idx = write_pos[:, None] + jnp.arange(S)[None, :]  # [B,S]
+    for key, val in writes.items():
+        # Row indices are arange: sorted/unique flags keep XLA off the
+        # serializing general-scatter path; per-(b,t) payloads are
+        # contiguous [Hkv, ...] chunks in this layout.
+        out[key] = cache[key].at[li, rows[:, None], idx].set(
+            val.astype(cache[key].dtype),
+            indices_are_sorted=True, unique_indices=True,
+        )
+    return out
+
+
+def _read_layer_kv(cache: Cache, li, compute_dtype,
+                   quantized: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """This layer's [B,T,Hkv,Dh] k/v view, dequantized to compute dtype.
+    The HBM read is int8 when quantized (the point); the dequant multiply
+    happens on-chip and fuses into the attention einsum."""
+    ck = jax.lax.dynamic_index_in_dim(cache["k"], li, 0, keepdims=False)
+    cv = jax.lax.dynamic_index_in_dim(cache["v"], li, 0, keepdims=False)
+    if quantized:
+        ks = jax.lax.dynamic_index_in_dim(
+            cache["k_scale"], li, 0, keepdims=False
+        )
+        vs = jax.lax.dynamic_index_in_dim(
+            cache["v_scale"], li, 0, keepdims=False
+        )
+        ck = ck.astype(compute_dtype) * ks[..., None].astype(compute_dtype)
+        cv = cv.astype(compute_dtype) * vs[..., None].astype(compute_dtype)
+        return ck, cv
+    return ck.astype(compute_dtype), cv.astype(compute_dtype)
+
+
 def _block(
     x: jnp.ndarray,
     bp: Dict[str, jnp.ndarray],
@@ -178,25 +243,24 @@ def _block(
     positions: jnp.ndarray,
     inv_freq: jnp.ndarray,
     mask: jnp.ndarray,
-    kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     write_pos: Optional[jnp.ndarray] = None,
     act_spec: Optional[P] = None,
-    full_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = None,
+    full_cache: Optional[Tuple[Cache, jnp.ndarray]] = None,
     ring_mesh=None,
+    decode_kernel: bool = False,
 ):
     """One transformer block.
 
-    Cached attention comes in two forms:
-      * `kv=(k_cache, v_cache)` — this layer's [B, W, Hkv, Dh] slices;
-        returns updated slices (the layer scan stacks them as ys).
-      * `full_cache=(K, V, layer_idx)` — the WHOLE [L, B, W, Hkv, Dh]
-        cache carried through the layer scan; fresh k/v are scattered into
-        layer_idx's slots IN PLACE (donated carry) and only the touched
-        slots are written. The `kv` form rebuilds the full cache as scan
-        ys every step — a full-cache write per token that measured ~40%
-        of decode-step time at [96 slots, 257 window] on v5e."""
+    Cached attention carries the WHOLE cache dict (arrays [L, B, W, ...])
+    through the layer scan as `full_cache=(cache, layer_idx)`: fresh k/v
+    are scattered into layer_idx's slots IN PLACE (donated carry) and
+    only the touched slots are written — rebuilding the cache as scan ys
+    measured ~40% of decode-step time at [96 slots, 257 window] on v5e.
+    With cfg.kv_cache_dtype == "int8", slots store per-(token, head)
+    symmetric int8 + scales, halving the cache read per decoded token."""
     B, S, D = x.shape
     Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+    quantized = cfg.kv_cache_dtype == "int8"
 
     h = rms_norm(x, bp["attn_norm"], cfg.rms_norm_eps)
     q = jnp.einsum("bsd,dh->bsh", h, bp["wq"]).reshape(B, S, cfg.n_heads, Dh)
@@ -205,11 +269,7 @@ def _block(
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
-    window = (
-        full_cache[0].shape[2] if full_cache is not None
-        else kv[0].shape[1] if kv is not None
-        else None
-    )
+    window = full_cache[0]["k"].shape[2] if full_cache is not None else None
     # Flash covers the no-cache path AND whole-window cached prefill (the
     # serving path: the sub-cache window equals the prompt bucket, so
     # attention is causal over the fresh k/v and the cache write is just the
@@ -222,7 +282,7 @@ def _block(
     # (parallel/ring_attention.py). Cache-free only: scoring + training.
     use_ring = (
         cfg.attn_impl == "ring" and ring_mesh is not None and S > 1
-        and kv is None and full_cache is None
+        and full_cache is None
     )
 
     if use_ring:
@@ -253,61 +313,42 @@ def _block(
             .reshape(B, S, cfg.n_heads * Dh)
         )
         if full_cache is not None:
-            ckf, cvf, li = full_cache
-            ckf = jax.lax.dynamic_update_index_in_dim(
-                ckf, k.astype(ckf.dtype), li, 0
-            )
-            cvf = jax.lax.dynamic_update_index_in_dim(
-                cvf, v.astype(cvf.dtype), li, 0
-            )
-            new_kv = (ckf, cvf)
+            cache, li = full_cache
+            new_kv = _write_cache(cache, li, k, v, write_pos, quantized,
+                                  whole_window=True)
         else:
-            new_kv = None if kv is None else (k, v)
+            new_kv = None
     elif full_cache is not None:
-        ckf, cvf, li = full_cache
-        if S == window:
-            ckf = jax.lax.dynamic_update_index_in_dim(
-                ckf, k.astype(ckf.dtype), li, 0
+        cache, li = full_cache
+        cache = _write_cache(cache, li, k, v, write_pos, quantized,
+                             whole_window=(S == window))
+        if decode_kernel and S == 1:
+            # Pallas decode kernel: full-tile MXU matmuls + in-kernel int8
+            # dequant (ops/decode_attention.py). Single-chip serving path
+            # (pallas doesn't auto-partition under GSPMD).
+            from seldon_tpu.ops.decode_attention import decode_attention
+
+            # The kernel wants head-major [B,Hkv,T,Dh]; the transpose is
+            # a real copy, which is why this path is opt-in (see engine).
+            ck = jax.lax.dynamic_index_in_dim(
+                cache["k"], li, 0, False).transpose(0, 2, 1, 3)
+            cv = jax.lax.dynamic_index_in_dim(
+                cache["v"], li, 0, False).transpose(0, 2, 1, 3)
+            if quantized:
+                ks = jax.lax.dynamic_index_in_dim(
+                    cache["k_scale"], li, 0, False).transpose(0, 2, 1)
+                vs = jax.lax.dynamic_index_in_dim(
+                    cache["v_scale"], li, 0, False).transpose(0, 2, 1)
+            else:
+                ks = vs = None
+            out = decode_attention(
+                q[:, 0], ck, cv, write_pos, k_scale=ks, v_scale=vs
             )
-            cvf = jax.lax.dynamic_update_index_in_dim(
-                cvf, v.astype(cvf.dtype), li, 0
-            )
+            attn = out[:, None].reshape(B, S, cfg.n_heads * Dh)
         else:
-            rows = jnp.arange(B)
-            idx = write_pos[:, None] + jnp.arange(S)[None, :]  # [B,S]
-            ckf = ckf.at[li, rows[:, None], idx].set(
-                k.astype(ckf.dtype),
-                indices_are_sorted=True, unique_indices=True,
-            )
-            cvf = cvf.at[li, rows[:, None], idx].set(
-                v.astype(cvf.dtype),
-                indices_are_sorted=True, unique_indices=True,
-            )
-        ck = jax.lax.dynamic_index_in_dim(ckf, li, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(cvf, li, 0, keepdims=False)
-        attn = gqa_attention(q, ck.astype(q.dtype), cv.astype(q.dtype), mask)
-        new_kv = (ckf, cvf)
-    elif kv is not None:
-        ck, cv = kv
-        if S == ck.shape[1]:
-            # Prefill covering the whole cache window: plain slot write.
-            ck, cv = k, v
-        else:
-            rows = jnp.arange(B)
-            idx = write_pos[:, None] + jnp.arange(S)[None, :]  # [B,S]
-            # Row indices are arange: sorted and unique — the flags let XLA
-            # lower the per-row scatter without the serializing general
-            # scatter path.
-            ck = ck.at[rows[:, None], idx].set(
-                k.astype(ck.dtype),
-                indices_are_sorted=True, unique_indices=True,
-            )
-            cv = cv.at[rows[:, None], idx].set(
-                v.astype(cv.dtype),
-                indices_are_sorted=True, unique_indices=True,
-            )
-        attn = gqa_attention(q, ck.astype(q.dtype), cv.astype(q.dtype), mask)
-        new_kv = (ck, cv)
+            ck, cv = _read_layer_kv(cache, li, q.dtype, quantized)
+            attn = gqa_attention(q, ck, cv, mask)
+        new_kv = cache
     else:
         attn = gqa_attention(q, k, v, mask)
         new_kv = None
@@ -328,7 +369,8 @@ def _block(
 
 
 def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
-                write_pos=None, act_spec=None, remat=False, ring_mesh=None):
+                write_pos=None, act_spec=None, remat=False, ring_mesh=None,
+                decode_kernel=False):
     """lax.scan over the stacked layer axis."""
 
     if cache is None:
@@ -343,26 +385,25 @@ def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
         x, aux = jax.lax.scan(body, x, params["blocks"])
         return x, None, jnp.mean(aux)
 
-    # Cached path: the FULL cache rides the scan carry (in-place slot
+    # Cached path: the FULL cache dict rides the scan carry (in-place slot
     # scatter per layer) instead of being rebuilt as stacked ys — see
     # _block's full_cache docstring for the measured cost.
     L = params["blocks"]["wq"].shape[0]
 
     def body(carry, scanned):
-        h, ckf, cvf = carry
+        h, c = carry
         bp, li = scanned
-        out, (ckf, cvf), aux = _block(
+        out, c, aux = _block(
             h, bp, cfg, positions, inv_freq, mask,
             write_pos=write_pos, act_spec=act_spec,
-            full_cache=(ckf, cvf, li),
+            full_cache=(c, li), decode_kernel=decode_kernel,
         )
-        return (out, ckf, cvf), aux
+        return (out, c), aux
 
-    (x, new_k, new_v), aux = jax.lax.scan(
-        body, (x, cache["k"], cache["v"]),
-        (params["blocks"], jnp.arange(L)),
+    (x, new_cache), aux = jax.lax.scan(
+        body, (x, cache), (params["blocks"], jnp.arange(L)),
     )
-    return x, {"k": new_k, "v": new_v}, jnp.mean(aux)
+    return x, new_cache, jnp.mean(aux)
 
 
 def _logits(params, x, cfg):
@@ -414,8 +455,26 @@ def forward(
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> Cache:
-    dt = dtype or _dtype(cfg)
+    """KV cache, token-major [L, B, T, Hkv, Dh] (scales [L, B, T, Hkv]).
+    Head-major was measured WORSE end-to-end on v5e: the decode write
+    becomes a 3-index-array scatter (strided [Hkv, Dh] chunks) that XLA
+    serializes, costing far more than the einsum layout gains."""
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.kv_cache_dtype == "int8":
+        assert dtype is None, (
+            "dtype override is meaningless for an int8 cache (slots are "
+            "int8 + f32 scales by construction)"
+        )
+        sshape = shape[:-1]  # [L, B, T, Hkv]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            # Scales min-clamped at init so a read of a never-written slot
+            # dequantizes to exact zeros (0 * 1e-8), like the bf16 cache.
+            "k_scale": jnp.full(sshape, 1e-8, jnp.float32),
+            "v_scale": jnp.full(sshape, 1e-8, jnp.float32),
+        }
+    dt = dtype or _dtype(cfg)
     return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
 
 
@@ -441,12 +500,14 @@ def prefill(
                                   cache=cache, write_pos=write_pos)
     else:
         # Write k/v into the leading S slots of the cache.
-        sub = {"k": cache["k"][:, :, :S], "v": cache["v"][:, :, :S]}
+        # Write k/v (and scales, for quantized caches) into the leading S
+        # slots; every cache array shares the [L, B, T, ...] layout.
+        sub = {key: arr[:, :, :S] for key, arr in cache.items()}
         x, sub, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
                                 cache=sub, write_pos=write_pos)
         cache = {
-            "k": cache["k"].at[:, :, :S].set(sub["k"]),
-            "v": cache["v"].at[:, :, :S].set(sub["v"]),
+            key: cache[key].at[:, :, :S].set(sub[key])
+            for key in cache
         }
     # Gather each row's last real hidden state BEFORE the vocab projection:
     # projecting all S positions would materialize [B,S,V] f32 (~4 GB for an
@@ -462,8 +523,11 @@ def decode_step(
     pos: jnp.ndarray,  # [B] int32 positions to write at
     cache: Cache,
     cfg: ModelConfig,
+    decode_kernel: bool = False,
 ) -> Tuple[jnp.ndarray, Cache]:
-    """One autoregressive step. Returns (logits [B, V], updated cache)."""
+    """One autoregressive step. Returns (logits [B, V], updated cache).
+    decode_kernel routes attention through the pallas decode kernel
+    (single-chip TPU serving; the engine sets it from its mesh)."""
     B = token.shape[0]
     Smax = cache["k"].shape[2]
     x = jnp.take(params["embed"], token, axis=0)[:, None, :]  # [B,1,D]
@@ -472,5 +536,6 @@ def decode_step(
     # Attend to every cache slot <= own position (slot pos is written first).
     mask = (jnp.arange(Smax)[None, None, :] <= pos[:, None, None])  # [B,1,Smax]
     x, cache, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                              cache=cache, write_pos=pos)
+                              cache=cache, write_pos=pos,
+                              decode_kernel=decode_kernel)
     return _logits(params, x, cfg)[:, 0], cache

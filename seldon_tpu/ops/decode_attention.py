@@ -1,0 +1,244 @@
+"""Decode attention (S=1 queries over the KV cache) — pallas TPU kernel.
+
+Why: XLA lowers per-step cache attention to B*Hkv tiny matmuls
+([G, Dh] x [Dh, T] with G = q heads per kv head, typically 2-8 rows) —
+~1.6% MXU row utilization, and the dominant share of a decode step once
+weights are amortized over enough slots. This kernel restructures both
+matmuls so the MXU sees full tiles:
+
+    scores^T [T_t, G] = K_tile [T_t, Dh] . q^T   (M = T_t = 128)
+    acc      [Dh, G] += V_tile^T . p             (M = Dh = 128)
+
+with the usual online-softmax accumulators per q-group, streaming the
+cache through VMEM tile by tile. GQA is native (grid over B*Hkv, q
+pre-grouped [B*Hkv, G, Dh]). int8 KV slots dequantize INSIDE the kernel
+(per-(token, head) scales ride along as a second operand), so the HBM
+read stays 1 byte/element.
+
+Per-row `pos` bounds (continuous batching: every slot at a different
+position) arrive via scalar-memory refs; tail tiles beyond the cache
+window are masked by the same bound (pos < T always).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BLOCK_T = 128
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) implementation — CPU fallback + numerics oracle
+# ---------------------------------------------------------------------------
+
+
+def decode_attention_reference(
+    q: jnp.ndarray,  # [B, H, Dh]
+    k: jnp.ndarray,  # [B, Hkv, T, Dh] head-major (already dequantized)
+    v: jnp.ndarray,
+    pos: jnp.ndarray,  # [B] attend to t <= pos
+) -> jnp.ndarray:
+    B, H, Dh = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    qg = q.reshape(B, Hkv, G, Dh)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, k,
+                   preferred_element_type=jnp.float32) * (Dh**-0.5)
+    T = k.shape[2]
+    mask = jnp.arange(T)[None, None, None, :] <= pos[:, None, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,bktd->bkgd", w.astype(v.dtype), v)
+    return o.reshape(B, H, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel_bf16(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr, *, block_t, scale):
+    _decode_kernel(pos_ref, q_ref, k_ref, v_ref, None, None, o_ref,
+                   m_scr, l_scr, acc_scr, block_t=block_t, scale=scale,
+                   quantized=False)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_t, scale, quantized):
+    from jax.experimental import pallas as pl
+
+    tj = pl.program_id(1)
+
+    @pl.when(tj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Scalar-prefetched bound: the whole pos array sits in SMEM.
+    bound = pos_ref[pl.program_id(0)]  # attend to t <= bound
+
+    # Tiles wholly beyond the bound contribute nothing: skip their FLOPs.
+    @pl.when(tj * block_t <= bound)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [Hkv, G, Dh]
+        k = k_ref[0].astype(jnp.float32)  # [Hkv, block_t, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0][:, :, None].astype(jnp.float32)
+            v = v * vs_ref[0][:, :, None].astype(jnp.float32)
+
+        # Batched over kv heads; scores^T [Hkv, block_t, G] puts
+        # M = block_t on the MXU.
+        st = jax.lax.dot_general(
+            k, q, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        t_global = tj * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, st.shape, 1
+        )
+        st = jnp.where(t_global <= bound, st, NEG_INF)
+        # Zero v's masked rows: the tail tile reads past the cache window
+        # (pallas pads with garbage, possibly NaN) and 0 * NaN would
+        # poison the value matmul even though p is 0 there.
+        t_rows = tj * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, v.shape, 1
+        )
+        v = jnp.where(t_rows <= bound, v, 0.0)
+
+        m_prev = m_scr[:].reshape(st.shape[0], 1, st.shape[2])  # [Hkv,1,G]
+        m_cur = jnp.max(st, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(st - m_new)  # [Hkv, block_t, G]
+        alpha = jnp.exp(m_prev - m_new)  # [Hkv, 1, G]
+        l_scr[:] = (alpha[:, 0] * l_scr[:] + jnp.sum(p, axis=1))
+        # acc [Hkv, Dh, G]: M = Dh on the value matmul; alpha [Hkv,1,G]
+        # broadcasts over Dh.
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            v, p, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new[:, 0]
+
+    @pl.when(tj == pl.num_programs(1) - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)[:, None, :]  # [Hkv, 1, G]
+        out = acc_scr[:] / l  # [Hkv, Dh, G]
+        o_ref[0] = out.transpose(0, 2, 1).astype(o_ref.dtype)  # [Hkv,G,Dh]
+
+
+def _decode_pallas(q, k, v, pos, k_scale, v_scale, block_t, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Dh = q.shape
+    Hkv, T = k.shape[1], k.shape[2]
+    G = H // Hkv
+    quantized = k_scale is not None
+    block_t = min(block_t, T)
+    n_t = -(-T // block_t)  # ceil: tail tiles masked by the pos bound
+
+    qg = q.reshape(B, Hkv, G, Dh)
+
+    grid = (B, n_t)
+
+    # index maps receive the prefetched scalar ref as a trailing arg.
+    # DMA pruning: tiles past the row's bound clamp to the last live tile
+    # index, so a short row re-fetches an already-resident block instead
+    # of streaming the whole window — the compute skip (pl.when in the
+    # kernel) alone would leave the bandwidth untouched.
+    def kv_idx(b, t, pos_ref):
+        t_live = jnp.minimum(t, pos_ref[b] // block_t)
+        return (b, 0, t_live, 0)
+
+    def scale_idx(b, t, pos_ref):
+        t_live = jnp.minimum(t, pos_ref[b] // block_t)
+        return (b, 0, t_live)
+
+    kv_spec = pl.BlockSpec((1, Hkv, block_t, Dh), kv_idx)
+    q_spec = pl.BlockSpec((1, Hkv, G, Dh), lambda b, t, pos_ref: (b, 0, 0, 0))
+    if quantized:
+        kernel = functools.partial(
+            _decode_kernel, block_t=block_t, scale=Dh**-0.5, quantized=True,
+        )
+        scale_spec = pl.BlockSpec((1, Hkv, block_t), scale_idx)
+        in_specs = [q_spec, kv_spec, kv_spec, scale_spec, scale_spec]
+        args = (pos.astype(jnp.int32), qg, k, v, k_scale, v_scale)
+    else:
+        kernel = functools.partial(
+            _decode_kernel_bf16, block_t=block_t, scale=Dh**-0.5,
+        )
+        in_specs = [q_spec, kv_spec, kv_spec]
+        args = (pos.astype(jnp.int32), qg, k, v)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, Hkv, G, Dh), lambda b, t, pos_ref: (b, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, Dh, G), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(*args).reshape(B, H, Dh)
+
+
+def _on_tpu() -> bool:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover
+        return False
+    return platform in ("tpu", "axon")
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, Dh]
+    k: jnp.ndarray,  # [B, Hkv, T, Dh] head-major — bf16, or int8 + scales
+    v: jnp.ndarray,
+    pos: jnp.ndarray,  # [B] int32: attend to t <= pos[b]
+    k_scale: jnp.ndarray = None,  # [B, Hkv, T] when k/v are int8
+    v_scale: jnp.ndarray = None,
+    block_t: int = DEFAULT_BLOCK_T,
+    force_reference: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One-token-per-row attention over the cache; pallas on TPU (also
+    under interpret=True for CPU tests), XLA reference elsewhere."""
+    if force_reference or not (_on_tpu() or interpret):
+        if k_scale is not None:
+            k = k.astype(jnp.float32) * k_scale[..., None]
+            v = v.astype(jnp.float32) * v_scale[..., None]
+        return decode_attention_reference(
+            q, k.astype(q.dtype), v.astype(q.dtype), pos
+        )
+    try:
+        return _decode_pallas(q, k, v, pos, k_scale, v_scale, block_t,
+                              interpret)
+    except Exception:  # pragma: no cover - backend quirks
+        logger.exception(
+            "pallas decode attention failed; falling back to reference "
+            "(q=%s k=%s)", q.shape, k.shape,
+        )
+        if k_scale is not None:
+            k = k.astype(jnp.float32) * k_scale[..., None]
+            v = v.astype(jnp.float32) * v_scale[..., None]
+        return decode_attention_reference(
+            q, k.astype(q.dtype), v.astype(q.dtype), pos
+        )

@@ -53,9 +53,19 @@ def param_pspecs(cfg) -> Dict[str, Any]:
     return specs
 
 
-def cache_pspec() -> P:
-    """KV cache [L, B, S, Hkv, Dh]: batch over dp, kv heads over tp."""
-    return P(None, "dp", None, "tp", None)
+def cache_pspec(cfg=None) -> Any:
+    """KV-cache shardings: batch over dp, kv heads over tp.
+
+    Returns a spec DICT matching transformer.init_cache's leaves: k/v
+    [L, B, T, Hkv, Dh] (+ 4-dim k_scale/v_scale [L, B, T, Hkv] for
+    kv_cache_dtype == "int8" configs). Apply with
+    `jax.tree.map(..., cache, cache_pspec(cfg))`."""
+    kv = P(None, "dp", None, "tp", None)
+    specs = {"k": kv, "v": kv}
+    if cfg is not None and getattr(cfg, "kv_cache_dtype", "bf16") == "int8":
+        scale = P(None, "dp", None, "tp")
+        specs.update({"k_scale": scale, "v_scale": scale})
+    return specs
 
 
 def batch_pspec(seq_sharded: bool = False) -> P:

@@ -134,11 +134,32 @@ class InferenceEngine:
             functools.partial(self._admit_impl, cfg=self.cfg),
             donate_argnums=(1,),
         )
+        # Pallas decode-attention kernel: EXPERIMENTAL opt-in
+        # (SELDON_TPU_DECODE_KERNEL=1). Measured on v5e it matches XLA's
+        # cache attention standalone but loses in the layer scan: a pallas
+        # operand must be materialized, so the per-layer dynamic slice of
+        # the cache becomes a real 2x84MB copy per layer per step that
+        # XLA's einsum path fuses away. Single-chip + TPU only (pallas
+        # doesn't auto-partition under GSPMD).
+        import os as _os
+
+        from seldon_tpu.ops.decode_attention import _on_tpu
+
+        n_mesh_devices = (
+            1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+        )
+        self._decode_kernel = (
+            _os.environ.get("SELDON_TPU_DECODE_KERNEL", "0") == "1"
+            and n_mesh_devices == 1
+            and _on_tpu()  # same gate the kernel's dispatch uses
+        )
+
         self._jit_chunk = jax.jit(
             functools.partial(
                 self._chunk_impl,
                 cfg=self.cfg,
                 n_steps=max(1, self.ecfg.decode_chunk),
+                decode_kernel=self._decode_kernel,
             ),
             donate_argnums=(1,),
         )
@@ -187,10 +208,16 @@ class InferenceEngine:
             | (max_news <= 1)
             | (plens + 1 >= Smax)
         )
-        k = cache["k"].at[:, slots, :Sb].set(sub["k"].astype(cache["k"].dtype))
-        v = cache["v"].at[:, slots, :Sb].set(sub["v"].astype(cache["v"].dtype))
+        # Scatter EVERY cache array (k/v + scales for quantized caches —
+        # all share the token-major [L, B, T, ...] leading layout).
+        new_cache = {
+            key: cache[key].at[:, slots, :Sb].set(
+                sub[key].astype(cache[key].dtype)
+            )
+            for key in cache
+        }
         new_state = {
-            "cache": {"k": k, "v": v},
+            "cache": new_cache,
             "last_tok": state["last_tok"].at[slots].set(first),
             "pos": state["pos"].at[slots].set(plens),
             "active": state["active"].at[slots].set(~first_done),
@@ -203,7 +230,7 @@ class InferenceEngine:
         return new_state, first, first_done
 
     @staticmethod
-    def _chunk_impl(params, state, *, cfg, n_steps):
+    def _chunk_impl(params, state, *, cfg, n_steps, decode_kernel=False):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
         value-level: finished rows stop advancing and emit invalid tokens
@@ -213,7 +240,8 @@ class InferenceEngine:
         def step(carry, _):
             run = carry["active"]
             logits, cache = transformer.decode_step(
-                params, carry["last_tok"], carry["pos"], carry["cache"], cfg
+                params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
+                decode_kernel=decode_kernel,
             )
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
