@@ -1,7 +1,22 @@
-.PHONY: proto test lint
+# CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
+.PHONY: proto test test-e2e bench bench-orchestrator native ci
 
 proto:
 	protoc --python_out=seldon_tpu/proto -I seldon_tpu/proto seldon_tpu/proto/prediction.proto
 
+native:
+	$(MAKE) -C native
+
 test:
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q -m "not e2e"
+
+test-e2e:
+	python -m pytest tests/ -x -q -m e2e
+
+bench:
+	python bench.py
+
+bench-orchestrator:
+	python bench_orchestrator.py
+
+ci: test test-e2e

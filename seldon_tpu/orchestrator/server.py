@@ -184,6 +184,13 @@ class EngineServer:
         app.router.add_post("/unpause", unpause)
         app.router.add_get("/prometheus", metrics_handler)
         app.router.add_get("/metrics", metrics_handler)
+
+        async def openapi_handler(request: web.Request) -> web.Response:
+            from seldon_tpu.core.openapi import engine_openapi
+
+            return web.json_response(engine_openapi(self.spec.name))
+
+        app.router.add_get("/seldon.json", openapi_handler)
         return app
 
     # --- gRPC ---------------------------------------------------------------

@@ -210,6 +210,15 @@ def build_rest_app(
     app.router.add_get("/metadata", handle_metadata)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/prometheus", handle_metrics)
+
+    async def handle_openapi(request: web.Request) -> web.Response:
+        # Reference parity: wrapper serves its schema at /seldon.json
+        # (python/seldon_core/wrapper.py:33-35).
+        from seldon_tpu.core.openapi import unit_openapi
+
+        return web.json_response(unit_openapi(_unit_name()))
+
+    app.router.add_get("/seldon.json", handle_openapi)
     return app
 
 

@@ -1,0 +1,30 @@
+"""Deterministic fixed-output models for e2e tests (the reference's
+fixed-model trick, testing/docker/fixed-model/ModelV1.py:10-21: hardwired
+outputs let tests identify WHICH graph version served a request purely
+from values + meta.requestPath)."""
+
+import numpy as np
+
+
+class ModelV1:
+    def predict(self, X, names, meta=None):
+        return np.tile([1.0, 2.0, 3.0, 4.0], (np.asarray(X).shape[0], 1))
+
+    def tags(self):
+        return {"version": "v1"}
+
+
+class ModelV2:
+    def predict(self, X, names, meta=None):
+        return np.tile([5.0, 6.0, 7.0, 8.0], (np.asarray(X).shape[0], 1))
+
+    def tags(self):
+        return {"version": "v2"}
+
+
+class DoublerTransformer:
+    def transform_input(self, X, names, meta=None):
+        return np.asarray(X) * 2.0
+
+    def tags(self):
+        return {"scaled": True}

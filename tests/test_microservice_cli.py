@@ -191,3 +191,88 @@ def test_persistence_none_when_empty(tmp_path, monkeypatch):
     monkeypatch.setattr(persistence, "_STATE_DIR", str(tmp_path))
     monkeypatch.setenv("PREDICTIVE_UNIT_ID", "nothing-here")
     assert persistence.restore(_Bandit()) is None
+
+
+def test_openapi_served_at_seldon_json():
+    """Reference parity: /seldon.json on both unit wrapper and engine."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web
+
+    from seldon_tpu.orchestrator.server import EngineServer
+    from seldon_tpu.orchestrator.spec import PredictiveUnit, PredictorSpec
+    from seldon_tpu.runtime.wrapper import build_rest_app
+
+    class M:
+        def predict(self, X, names, meta=None):
+            return X
+
+    async def run():
+        runner = web.AppRunner(build_rest_app(M()))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        es = EngineServer(
+            spec=PredictorSpec(name="p", graph=PredictiveUnit(
+                name="m", type="MODEL", implementation="SIMPLE_MODEL")),
+            http_port=0, grpc_port=0,
+        )
+        await es.start(host="127.0.0.1")
+        eport = None
+        for s in es._runner.sites:
+            eport = s._server.sockets[0].getsockname()[1]
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                f"http://127.0.0.1:{port}/seldon.json"
+            ) as r:
+                unit_spec = await r.json()
+            async with sess.get(
+                f"http://127.0.0.1:{eport}/seldon.json"
+            ) as r:
+                engine_spec = await r.json()
+        await runner.cleanup()
+        await es.stop()
+        return unit_spec, engine_spec
+
+    unit_spec, engine_spec = asyncio.run(run())
+    assert unit_spec["openapi"].startswith("3.")
+    assert "/predict" in unit_spec["paths"]
+    assert "/send-feedback" in unit_spec["paths"]
+    assert "/api/v0.1/predictions" in engine_spec["paths"]
+    # Schema shape: SeldonMessage body documented for JSON + proto.
+    op = engine_spec["paths"]["/api/v0.1/predictions"]["post"]
+    assert "application/x-protobuf" in op["requestBody"]["content"]
+
+
+def test_openapi_paths_exist_in_routers():
+    """Anti-drift: every path the schema documents must be mounted by the
+    actual server (spec subset-of routes, checked against the routers)."""
+    from seldon_tpu.core.openapi import engine_openapi, unit_openapi
+    from seldon_tpu.orchestrator.server import EngineServer
+    from seldon_tpu.orchestrator.spec import PredictiveUnit, PredictorSpec
+    from seldon_tpu.runtime.wrapper import build_rest_app
+
+    class M:
+        def predict(self, X, names, meta=None):
+            return X
+
+    unit_routes = {
+        r.resource.canonical
+        for r in build_rest_app(M()).router.routes()
+        if r.resource is not None
+    }
+    for path in unit_openapi()["paths"]:
+        assert path in unit_routes, f"unit spec documents unmounted {path}"
+
+    es = EngineServer(spec=PredictorSpec(
+        name="p", graph=PredictiveUnit(name="m", type="MODEL",
+                                       implementation="SIMPLE_MODEL")))
+    engine_routes = {
+        r.resource.canonical
+        for r in es.build_app().router.routes()
+        if r.resource is not None
+    }
+    for path in engine_openapi()["paths"]:
+        assert path in engine_routes, f"engine spec documents unmounted {path}"
