@@ -175,11 +175,17 @@ class LocalProcessStore:
                     "--grpc-port", str(port), "--http-port", "0",
                 ]
             else:
-                # Custom image: MODEL_NAME env names the user class
-                # (the packaging entrypoint contract).
-                model = env.get(
-                    "MODEL_NAME", "seldon_tpu.orchestrator.units.SimpleModel"
-                )
+                # Custom image: MODEL_NAME env names the user class (the
+                # packaging entrypoint contract — always wins). Images
+                # named `local/<module.Class>:<tag>` carry the class as a
+                # fallback so manifests stay self-contained for this store.
+                image = c.get("image", "")
+                if env.get("MODEL_NAME"):
+                    model = env["MODEL_NAME"]
+                elif image.startswith("local/"):
+                    model = image[len("local/"):].rsplit(":", 1)[0]
+                else:
+                    model = "seldon_tpu.orchestrator.units.SimpleModel"
                 cmd = [
                     sys.executable, "-m", "seldon_tpu.runtime.microservice",
                     model, "--api-type", "GRPC",

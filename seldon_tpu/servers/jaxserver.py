@@ -82,13 +82,38 @@ class JAXServer(SeldonComponent):
             self._slice_ready = distributed.SliceReadiness()
 
             if self.model_uri:
+                import os as _os
+
                 from seldon_tpu.servers import checkpoint as ckpt
                 from seldon_tpu.servers.storage import download
 
                 local = download(self.model_uri)
                 self.tokenizer = load_tokenizer(local)
-                mesh = self._mesh_for(ckpt.load_config(local))
-                params, cfg = ckpt.load_checkpoint(local, mesh)
+                if _os.path.exists(_os.path.join(local, "config.json")) and any(
+                    f.endswith(".safetensors") for f in _os.listdir(local)
+                ):
+                    # HF Llama-family checkpoint (config.json +
+                    # safetensors): each stacked tensor is placed SHARDED
+                    # on the serving mesh as it streams in — a model
+                    # bigger than one chip's HBM never sits whole anywhere.
+                    from seldon_tpu.servers.hf_loader import load_hf_checkpoint
+
+                    mesh_holder = {}
+
+                    def _shardings(loaded_cfg):
+                        mesh_holder["mesh"] = self._mesh_for(loaded_cfg)
+                        return shd.named_shardings(
+                            mesh_holder["mesh"],
+                            shd.param_pspecs(loaded_cfg),
+                        )
+
+                    params, cfg = load_hf_checkpoint(
+                        local, make_shardings=_shardings
+                    )
+                    mesh = mesh_holder["mesh"]
+                else:
+                    mesh = self._mesh_for(ckpt.load_config(local))
+                    params, cfg = ckpt.load_checkpoint(local, mesh)
             else:
                 cfg = get_config(self.preset)
                 self.tokenizer = ByteTokenizer()

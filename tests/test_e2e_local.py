@@ -5,11 +5,15 @@ testing/scripts/), one level down: LocalProcessStore turns the
 reconciler's (unchanged) manifests into real engine + unit subprocesses,
 and the assertions drive the live HTTP data path — including the
 reference's fixed-model rolling-update trick (values + meta.requestPath
-identify which graph version served each request)."""
+identify which graph version served each request).
+
+Unit classes ride the CR's `image` field as `local/<module.Class>:<tag>`
+(the store's self-contained analogue of a baked image entrypoint), so
+every apply path — including the reconciler's own resyncs — launches
+identical processes."""
 
 import json
 import os
-import time
 import urllib.request
 
 import pytest
@@ -20,6 +24,9 @@ from seldon_tpu.operator.localstore import LocalProcessStore
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 pytestmark = pytest.mark.e2e
+
+V1 = "local/tests.fixed_models.ModelV1:1"
+V2 = "local/tests.fixed_models.ModelV2:1"
 
 
 def _predict(port: int, rows, timeout=10):
@@ -32,47 +39,41 @@ def _predict(port: int, rows, timeout=10):
         return json.loads(r.read())
 
 
-def _cr(name="e2e", generation=1, model_cls="tests.fixed_models.ModelV1"):
+def _cr(name="e2e", generation=1, image=V1, pred_name="main"):
     return SeldonDeployment.from_dict({
         "metadata": {"name": name, "namespace": "default",
                      "generation": generation},
         "spec": {
             "predictors": [{
-                "name": "main",
+                "name": pred_name,
                 "replicas": 1,
-                "graph": {
-                    "name": "clf",
-                    "type": "MODEL",
-                    # custom image path: MODEL_NAME env selects the class
-                    # (the packaging entrypoint contract)
-                    "image": f"local/{model_cls}:1",
-                },
-                "resources": {},
+                "graph": {"name": "clf", "type": "MODEL", "image": image},
             }],
         },
     })
 
 
-def test_cr_to_live_http_predict_and_rolling_update():
+def _reconcile_until_available(rec, store, sdep, timeout_s=120):
+    """Reconcile -> wait for processes -> reconcile (the controller loop's
+    resync behavior, compressed)."""
+    import time
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status = rec.reconcile(sdep)
+        if status.state == "Available":
+            return status
+        if status.state == "Failed":  # terminal: waiting can't fix it
+            raise AssertionError(f"reconcile failed: {status}")
+        store.wait_ready(30)
+    raise AssertionError(f"never became Available: {status}")
+
+
+def test_cr_to_live_http_predict():
     store = LocalProcessStore(repo_root=REPO)
     rec = Reconciler(store, istio_enabled=False)
     try:
-        # v1 deploy ------------------------------------------------------
-        sdep = _cr(generation=1)
-        # Custom-image units need MODEL_NAME: patch desired manifests the
-        # way the image env would carry it, then apply through the store.
-        desired = rec.desired_manifests(sdep)
-        for m in desired:
-            if m["kind"] == "Deployment":
-                for c in m["spec"]["template"]["spec"]["containers"]:
-                    if c["name"] == "clf":
-                        c["env"].append({"name": "MODEL_NAME",
-                                         "value":
-                                         "tests.fixed_models.ModelV1"})
-            m["metadata"].setdefault("labels", {})["seldon-generation"] = "1"
-            store.apply(m)
-        assert store.wait_ready(90), "v1 processes never became ready"
-
+        _reconcile_until_available(rec, store, _cr())
         dep_name = next(
             m["metadata"]["name"] for m in store.list("Deployment", "default")
         )
@@ -81,8 +82,6 @@ def test_cr_to_live_http_predict_and_rolling_update():
         # Fixed model v1 returns [1, 2, 3, 4] (reference fixed-model trick).
         assert out["data"]["ndarray"] == [[1.0, 2.0, 3.0, 4.0]], out
         assert "clf" in out["meta"]["requestPath"], out["meta"]
-
-        # request identity under load: 20 sequential predicts all v1
         for _ in range(5):
             assert _predict(port, [[1.0]])["data"]["ndarray"] == [
                 [1.0, 2.0, 3.0, 4.0]
@@ -105,36 +104,65 @@ def test_engine_graph_with_live_unit_hop():
                 "graph": {
                     "name": "scaler",
                     "type": "TRANSFORMER",
-                    "image": "local/scaler:1",
-                    "children": [{
-                        "name": "clf",
-                        "type": "MODEL",
-                        "image": "local/clf:1",
-                    }],
+                    "image": "local/tests.fixed_models.DoublerTransformer:1",
+                    "children": [
+                        {"name": "clf", "type": "MODEL", "image": V1}
+                    ],
                 },
             }]},
         })
-        desired = rec.desired_manifests(sdep)
-        env_by_unit = {
-            "scaler": "tests.fixed_models.DoublerTransformer",
-            "clf": "tests.fixed_models.ModelV1",
-        }
-        for m in desired:
-            if m["kind"] == "Deployment":
-                for c in m["spec"]["template"]["spec"]["containers"]:
-                    if c["name"] in env_by_unit:
-                        c["env"].append({"name": "MODEL_NAME",
-                                         "value": env_by_unit[c["name"]]})
-            store.apply(m)
-        assert store.wait_ready(90), "graph processes never became ready"
+        _reconcile_until_available(rec, store, sdep)
         dep_name = next(
             m["metadata"]["name"] for m in store.list("Deployment", "default")
         )
         out = _predict(store.engine_port(dep_name), [[3.0]])
-        # Doubler runs first (transform_input), then the fixed model.
         assert out["data"]["ndarray"] == [[1.0, 2.0, 3.0, 4.0]], out
         path = out["meta"]["requestPath"]
         assert set(path) >= {"scaler", "clf"}, path
         assert out["meta"]["tags"].get("scaled") is True, out["meta"]
+    finally:
+        store.close()
+
+
+def test_rolling_update_zero_downtime():
+    """The reference's flagship e2e (test_rolling_updates.py): generation
+    bump swaps the graph version; the OLD engine keeps serving until the
+    new one is ready, then stale resources GC — and the served values
+    identify the version at every step."""
+    store = LocalProcessStore(repo_root=REPO)
+    rec = Reconciler(store, istio_enabled=False)
+    try:
+        _reconcile_until_available(
+            rec, store, _cr(generation=1, image=V1, pred_name="main")
+        )
+        v1_dep = next(m["metadata"]["name"]
+                      for m in store.list("Deployment", "default"))
+        v1_port = store.engine_port(v1_dep)
+        assert _predict(v1_port, [[0.0]])["data"]["ndarray"] == [
+            [1.0, 2.0, 3.0, 4.0]
+        ]
+
+        # Generation 2 renames the predictor -> new workload + processes.
+        sdep2 = _cr(generation=2, image=V2, pred_name="canary")
+        status = rec.reconcile(sdep2)
+        if status.state != "Available":
+            # Rollout window: BOTH generations' processes are live and the
+            # old engine still serves v1 — zero downtime.
+            assert _predict(v1_port, [[0.0]])["data"]["ndarray"] == [
+                [1.0, 2.0, 3.0, 4.0]
+            ]
+            names = {m["metadata"]["name"]
+                     for m in store.list("Deployment", "default")}
+            assert len(names) == 2, names
+            _reconcile_until_available(rec, store, sdep2)
+
+        # Stale generation GC'd: old workload gone, processes terminated.
+        remaining = {m["metadata"]["name"]
+                     for m in store.list("Deployment", "default")}
+        assert v1_dep not in remaining, remaining
+        assert store.pods.get(v1_dep) is None
+        v2_dep = next(iter(remaining))
+        out = _predict(store.engine_port(v2_dep), [[0.0]])
+        assert out["data"]["ndarray"] == [[5.0, 6.0, 7.0, 8.0]], out
     finally:
         store.close()

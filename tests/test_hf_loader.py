@@ -1,0 +1,126 @@
+"""HF Llama checkpoint loader: LOGIT PARITY against transformers' own
+forward pass on a randomly initialized tiny Llama — the strongest
+possible check that weight mapping, transposes, RoPE convention, GQA
+grouping, and norms all line up."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_checkpoint(tmp_path_factory):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    path = tmp_path_factory.mktemp("hf-llama")
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def test_hf_config_mapping(tiny_hf_checkpoint):
+    from seldon_tpu.servers.hf_loader import load_hf_checkpoint
+
+    path, _ = tiny_hf_checkpoint
+    params, cfg = load_hf_checkpoint(path, dtype="float32")
+    assert cfg.n_layers == 3 and cfg.n_heads == 4 and cfg.n_kv_heads == 2
+    assert params["blocks"]["wq"].shape == (3, 64, 64)
+    assert params["blocks"]["wk"].shape == (3, 64, 32)  # GQA: 2 kv heads
+    assert params["blocks"]["w_gate"].shape == (3, 64, 128)
+    assert params["lm_head"].shape == (64, 128)
+
+
+def test_hf_logit_parity(tiny_hf_checkpoint):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from seldon_tpu.models import forward
+    from seldon_tpu.servers.hf_loader import load_hf_checkpoint
+
+    path, model = tiny_hf_checkpoint
+    params, cfg = load_hf_checkpoint(path, dtype="float32")
+    cfg = dataclasses.replace(cfg, dtype="float32")
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128, size=(2, 10))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+    # f32 end-to-end: tight tolerance proves the mapping is exact.
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_decode_matches_teacher_forcing(tiny_hf_checkpoint):
+    """Greedy cached decode on the loaded weights equals transformers'
+    greedy generate — the full serving path on an HF checkpoint."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from seldon_tpu.models import transformer
+    from seldon_tpu.servers.hf_loader import load_hf_checkpoint
+
+    path, model = tiny_hf_checkpoint
+    params, cfg = load_hf_checkpoint(path, dtype="float32")
+    cfg = dataclasses.replace(cfg, dtype="float32")
+
+    prompt = [[5, 17, 99, 3]]
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.tensor(prompt), max_new_tokens=6, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[0, 4:].tolist()
+
+    cache = transformer.init_cache(cfg, 1, 32)
+    logits, cache = transformer.prefill(
+        params, jnp.asarray(prompt, jnp.int32), jnp.array([4]), cache, cfg
+    )
+    toks = [int(jnp.argmax(logits[0]))]
+    pos = jnp.array([4], jnp.int32)
+    for _ in range(5):
+        lg, cache = transformer.decode_step(
+            params, jnp.array([toks[-1]], jnp.int32), pos, cache, cfg
+        )
+        toks.append(int(jnp.argmax(lg[0])))
+        pos = pos + 1
+    assert toks == hf_out, (toks, hf_out)
+
+
+def test_rejects_non_llama(tmp_path):
+    import json
+
+    from seldon_tpu.servers.hf_loader import config_from_hf
+
+    with pytest.raises(ValueError):
+        config_from_hf({"model_type": "gpt2"})
+
+
+def test_jaxserver_serves_hf_checkpoint(tiny_hf_checkpoint):
+    """JAXServer end-to-end on an HF checkpoint directory: load -> engine
+    -> generate."""
+    from seldon_tpu.servers.jaxserver import JAXServer
+
+    path, _ = tiny_hf_checkpoint
+    srv = JAXServer(model_uri=path, max_slots=2, max_seq_len=48)
+    srv.load()
+    try:
+        out = srv.generate({"prompt": "ab", "max_new_tokens": 4, "seed": 1})
+        assert out["completion_tokens"] >= 1
+        assert srv.cfg.n_layers == 3  # config came from config.json
+    finally:
+        srv.engine.stop()
