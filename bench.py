@@ -34,6 +34,7 @@ PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", 128))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW", 128))
 DECODE_CHUNK = int(os.environ.get("BENCH_CHUNK", 64))  # 32 -> 0.78x, 64 -> 0.82x
 KV_DTYPE = os.environ.get("BENCH_KV", "bf16")
+ATTN = os.environ.get("BENCH_ATTN", "")
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
@@ -46,10 +47,12 @@ def main() -> None:
     from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
 
     cfg = get_config(PRESET)
-    if KV_DTYPE != "bf16":
-        import dataclasses
+    import dataclasses
 
+    if KV_DTYPE != "bf16":
         cfg = dataclasses.replace(cfg, kv_cache_dtype=KV_DTYPE)
+    if ATTN:
+        cfg = dataclasses.replace(cfg, attn_impl=ATTN)
     params = init_params(cfg, jax.random.key(0))
 
     ecfg = EngineConfig(

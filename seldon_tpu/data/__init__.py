@@ -1,0 +1,8 @@
+"""Training data pipeline: token shards + native prefetching loader."""
+
+from seldon_tpu.data.loader import (
+    TokenDataLoader,
+    write_token_shard,
+)
+
+__all__ = ["TokenDataLoader", "write_token_shard"]

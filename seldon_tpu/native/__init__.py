@@ -27,6 +27,30 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
+def load_native_lib(so_name: str) -> Optional[ctypes.CDLL]:
+    """Shared scaffolding for every native library in native/: auto-build
+    via `make -C native <so_name>` when absent (and the source tree
+    exists), then CDLL-load; None on any failure (callers fall back)."""
+    lib_path = os.path.join(_NATIVE_DIR, so_name)
+    if not os.path.exists(lib_path) and os.path.isdir(_NATIVE_DIR):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, so_name],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception:
+            logger.warning("%s build failed; using fallbacks", so_name,
+                           exc_info=True)
+            return None
+    if not os.path.exists(lib_path):
+        return None
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        logger.warning("failed to load %s", lib_path, exc_info=True)
+        return None
+
+
 def _build() -> bool:
     try:
         subprocess.run(

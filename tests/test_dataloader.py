@@ -1,0 +1,104 @@
+"""Native prefetching data loader vs the bit-identical numpy fallback,
+and end-to-end into the sharded train step."""
+
+import numpy as np
+import pytest
+
+from seldon_tpu.data import TokenDataLoader, write_token_shard
+
+
+@pytest.fixture()
+def shards(tmp_path):
+    rng = np.random.default_rng(0)
+    p1 = write_token_shard(str(tmp_path / "a.bin"),
+                           rng.integers(0, 250, size=5000))
+    p2 = write_token_shard(str(tmp_path / "b.bin"),
+                           rng.integers(0, 250, size=3000))
+    return [p1, p2]
+
+
+def test_native_lib_loads(shards):
+    dl = TokenDataLoader(shards, batch_size=4, seq_len=32, seed=1)
+    try:
+        assert dl.native, "native dataloader should build in this image"
+        assert dl.total_tokens == 8000
+        b = next(dl)
+        assert b.shape == (4, 33) and b.dtype == np.int32
+        assert (b >= 0).all() and (b < 250).all()
+    finally:
+        dl.close()
+
+
+def test_native_and_fallback_bit_identical(shards):
+    native = TokenDataLoader(shards, batch_size=8, seq_len=64, seed=42)
+    fallback = TokenDataLoader(shards, batch_size=8, seq_len=64, seed=42,
+                               force_fallback=True)
+    try:
+        assert native.native and not fallback.native
+        for _ in range(10):
+            np.testing.assert_array_equal(next(native), next(fallback))
+    finally:
+        native.close()
+
+
+def test_deterministic_and_seed_sensitive(shards):
+    a = TokenDataLoader(shards, batch_size=4, seq_len=16, seed=7,
+                        force_fallback=True)
+    b = TokenDataLoader(shards, batch_size=4, seq_len=16, seed=7,
+                        force_fallback=True)
+    c = TokenDataLoader(shards, batch_size=4, seq_len=16, seed=8,
+                        force_fallback=True)
+    np.testing.assert_array_equal(next(a), next(b))
+    assert not np.array_equal(next(a), next(c))
+
+
+def test_windows_are_real_corpus_slices(shards):
+    """Every emitted window must be a contiguous slice of the concatenated
+    corpus (catches off-by-ones and shard-boundary bugs)."""
+    corpus = np.concatenate([np.fromfile(p, dtype="<u4") for p in shards])
+    dl = TokenDataLoader(shards, batch_size=16, seq_len=48, seed=3)
+    try:
+        for _ in range(5):
+            batch = next(dl)
+            for row in batch:
+                # locate by first two tokens then verify the whole window
+                starts = np.where(
+                    (corpus[:-49] == row[0]) & (corpus[1:-48] == row[1])
+                )[0]
+                assert any(
+                    np.array_equal(corpus[s: s + 49], row) for s in starts
+                ), "window is not a contiguous corpus slice"
+    finally:
+        dl.close()
+
+
+def test_feeds_train_step(shards):
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_tpu.models import get_config
+    from seldon_tpu.models.train import make_optimizer, make_sharded_train_step
+    from seldon_tpu.parallel import MeshPlan, make_mesh
+
+    cfg = get_config("tiny")
+    mesh = make_mesh(MeshPlan(dp=2))
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, cfg, make_optimizer(total_steps=10), seq_sharded=False
+    )
+    state = init_fn(jax.random.key(0))
+    dl = TokenDataLoader(shards, batch_size=4, seq_len=31, seed=0)
+    try:
+        for _ in range(2):
+            batch = jnp.asarray(next(dl)[:, :32])  # [B, S]
+            state, metrics = step_fn(
+                state, batch, jnp.ones_like(batch, jnp.float32)
+            )
+            assert np.isfinite(float(metrics["loss"]))
+    finally:
+        dl.close()
+
+
+def test_too_small_corpus_raises(tmp_path):
+    p = write_token_shard(str(tmp_path / "tiny.bin"), [1, 2, 3])
+    with pytest.raises(ValueError):
+        TokenDataLoader([p], batch_size=1, seq_len=16, force_fallback=True)
