@@ -39,6 +39,11 @@ class ModelConfig:
     # quantization of KV slots — halves the cache read per decode step,
     # the serving bottleneck at high slot counts.
     kv_cache_dtype: str = "bf16"
+    # "bf16" or "int8": weight-only quantization (per-output-channel
+    # scales, models/quantize.py) — halves weight HBM reads and the
+    # footprint (llama3-8b on one 16GB v5e chip needs this). Applied by
+    # loaders via quantize_params; compute stays bf16.
+    weight_dtype: str = "bf16"
 
     @property
     def head_dim(self) -> int:
@@ -56,6 +61,9 @@ class ModelConfig:
         )
         assert self.kv_cache_dtype in ("bf16", "int8"), (
             f"unknown kv_cache_dtype {self.kv_cache_dtype!r}"
+        )
+        assert self.weight_dtype in ("bf16", "int8"), (
+            f"unknown weight_dtype {self.weight_dtype!r}"
         )
         if self.n_experts:
             assert self.n_experts_per_token <= self.n_experts

@@ -1,0 +1,80 @@
+"""Weight-only int8 quantization for serving.
+
+Why: decode is HBM-bound on weight reads once enough slots amortize the
+cache; int8 weights halve that traffic (bench-1b: 2.2 GB -> 1.1 GB per
+step) and halve the footprint, which is what lets llama3-8b-class models
+(16 GB bf16) serve from one 16 GB v5e chip at all.
+
+Scheme: symmetric per-OUTPUT-CHANNEL scales (the einsum's last axis), so
+`w ≈ w_q.astype(bf16) * scale[None, :]`. XLA fuses the convert+multiply
+into the matmul's operand read — the HBM side stays 1 byte/element; no
+custom kernel needed. Activations, norms, router logits stay bf16/f32.
+
+The transformer consumes quantized leaves transparently: for each
+quantized weight `name`, the params tree carries `name` (int8) plus
+`name_scale` (f32, broadcastable), and `models.transformer._w` dequants
+at use. `quantize_params` works on any already-built tree (random init,
+orbax, HF loader), so one code path covers every loader.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# Block leaves quantized per output channel (last axis). Norm gains and
+# the MoE router stay full precision.
+_BLOCK_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _quantize_leaf(w: jnp.ndarray):
+    """-> (int8 w_q, f32 scale broadcastable against w)."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    w_q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return w_q, scale
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """int8-quantize the matmul weights of a transformer param tree
+    (blocks + embed + lm_head); returns a NEW tree with `*_scale` leaves
+    alongside each quantized weight. Idempotent: re-quantizing an int8
+    tree would compute scale=max(|int8|)/127~=1 and DROP the real
+    per-channel scales — silently garbage weights."""
+    if is_quantized(params):
+        return params
+    out: Dict[str, Any] = {}
+    blocks = dict(params["blocks"])
+    for name in _BLOCK_WEIGHTS:
+        if name not in blocks:
+            continue
+        w_q, scale = _quantize_leaf(blocks[name])
+        blocks[name] = w_q
+        blocks[f"{name}_scale"] = scale
+    out["blocks"] = blocks
+
+    # Embed rows are gathered then matmul'd (tied logits): per-COLUMN
+    # scale over d_model keeps both uses a plain broadcast multiply.
+    embed_q, embed_scale = _quantize_leaf(params["embed"])
+    out["embed"] = embed_q
+    out["embed_scale"] = embed_scale
+    out["final_norm"] = params["final_norm"]
+    if "lm_head" in params:
+        lm_q, lm_scale = _quantize_leaf(params["lm_head"])
+        out["lm_head"] = lm_q
+        out["lm_head_scale"] = lm_scale
+    return out
+
+
+def is_quantized(params: Dict[str, Any]) -> bool:
+    return "embed_scale" in params
+
+
+def dequant(w: jnp.ndarray, scale, dtype) -> jnp.ndarray:
+    """Dequantize at use; fuses into the consuming matmul under XLA."""
+    if scale is None:
+        return w if w.dtype == dtype else w.astype(dtype)
+    return w.astype(dtype) * scale.astype(dtype)

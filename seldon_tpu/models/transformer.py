@@ -22,8 +22,26 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from seldon_tpu.models.config import ModelConfig
+from seldon_tpu.models.quantize import dequant
 
 Params = Dict[str, Any]
+
+
+def _w(container: Dict[str, Any], name: str, dtype) -> jnp.ndarray:
+    """Weight fetch with transparent int8 dequant (models/quantize.py):
+    `name_scale` present -> int8 * per-output-channel scale, which XLA
+    fuses into the consuming matmul's operand read."""
+    return dequant(container[name], container.get(name + "_scale"), dtype)
+
+
+def _embed_rows(params: Params, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Embedding gather with transparent dequant (scale is per-column,
+    so it broadcasts over gathered rows)."""
+    rows = jnp.take(params["embed"], tokens, axis=0)
+    scale = params.get("embed_scale")
+    if scale is None:
+        return rows
+    return rows.astype(dtype) * scale.astype(dtype)[0]
 Cache = Dict[str, jnp.ndarray]
 
 
@@ -159,10 +177,12 @@ def moe_block(x: jnp.ndarray, bp: Dict[str, jnp.ndarray], cfg: ModelConfig):
     # minimized (→1) by a uniform router, grows as experts collapse.
     frac = onehot.sum(axis=2).mean(axis=(0, 1)) / K  # [E]
     lb_loss = E * jnp.sum(frac * probs_full.mean(axis=(0, 1)))
-    hidden = jax.nn.silu(jnp.einsum("bsd,edf->besf", x, bp["w_gate"])) * jnp.einsum(
-        "bsd,edf->besf", x, bp["w_up"]
+    hidden = jax.nn.silu(
+        jnp.einsum("bsd,edf->besf", x, _w(bp, "w_gate", x.dtype))
+    ) * jnp.einsum("bsd,edf->besf", x, _w(bp, "w_up", x.dtype))
+    expert_out = jnp.einsum(
+        "besf,efd->besd", hidden, _w(bp, "w_down", x.dtype)
     )
-    expert_out = jnp.einsum("besf,efd->besd", hidden, bp["w_down"])
     return jnp.einsum("besd,bse->bsd", expert_out, mix.astype(x.dtype)), lb_loss
 
 
@@ -263,9 +283,9 @@ def _block(
     quantized = cfg.kv_cache_dtype == "int8"
 
     h = rms_norm(x, bp["attn_norm"], cfg.rms_norm_eps)
-    q = jnp.einsum("bsd,dh->bsh", h, bp["wq"]).reshape(B, S, cfg.n_heads, Dh)
-    k = jnp.einsum("bsd,dh->bsh", h, bp["wk"]).reshape(B, S, Hkv, Dh)
-    v = jnp.einsum("bsd,dh->bsh", h, bp["wv"]).reshape(B, S, Hkv, Dh)
+    q = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wq", h.dtype)).reshape(B, S, cfg.n_heads, Dh)
+    k = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wk", h.dtype)).reshape(B, S, Hkv, Dh)
+    v = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wv", h.dtype)).reshape(B, S, Hkv, Dh)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
@@ -353,7 +373,7 @@ def _block(
         attn = gqa_attention(q, k, v, mask)
         new_kv = None
 
-    x = x + jnp.einsum("bsh,hd->bsd", attn, bp["wo"])
+    x = x + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
     h = rms_norm(x, bp["mlp_norm"], cfg.rms_norm_eps)
@@ -362,7 +382,8 @@ def _block(
         mlp_out, aux = moe_block(h, bp, cfg)
         x = x + mlp_out
     else:
-        x = x + swiglu(h, bp["w_gate"], bp["w_up"], bp["w_down"])
+        x = x + swiglu(h, _w(bp, "w_gate", h.dtype),
+                       _w(bp, "w_up", h.dtype), _w(bp, "w_down", h.dtype))
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
     return x, new_kv, aux
@@ -408,16 +429,18 @@ def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
 
 def _logits(params, x, cfg):
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
+    if "lm_head" not in params:
         # Tied embeddings: contract against embed's OWN layout ("vd") —
         # materializing embed.T would move the whole vocab matrix per
         # decode step (measured 2.3ms/step for a 131MB bf16 table on v5e).
         return jnp.einsum(
-            "bsd,vd->bsv", x, params["embed"],
+            "bsd,vd->bsv", x, _w(params, "embed", x.dtype),
             preferred_element_type=jnp.float32,
         )
-    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+    return jnp.einsum(
+        "bsd,dv->bsv", x, _w(params, "lm_head", x.dtype),
+        preferred_element_type=jnp.float32,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -439,7 +462,7 @@ def forward(
     dense configs). `ring_mesh` activates ring attention over 'sp' when
     cfg.attn_impl == "ring" (long-context path)."""
     B, S = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed_rows(params, tokens, _dtype(cfg))
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -489,7 +512,7 @@ def prefill(
     Returns (next-token logits [B, V] taken at each row's last real token,
     updated cache)."""
     B, S = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed_rows(params, tokens, _dtype(cfg))
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     inv_freq = rope_frequencies(cfg)
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
@@ -530,7 +553,7 @@ def decode_step(
     (single-chip TPU serving; the engine sets it from its mesh)."""
     B = token.shape[0]
     Smax = cache["k"].shape[2]
-    x = jnp.take(params["embed"], token, axis=0)[:, None, :]  # [B,1,D]
+    x = _embed_rows(params, token, _dtype(cfg))[:, None, :]  # [B,1,D]
     positions = pos[:, None]
     inv_freq = rope_frequencies(cfg)
     # Attend to every cache slot <= own position (slot pos is written first).

@@ -16,8 +16,11 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_pspecs(cfg) -> Dict[str, Any]:
-    """PartitionSpec pytree matching models.transformer.init_params."""
+def param_pspecs(cfg, quantized: bool = False) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models.transformer.init_params
+    (quantized=True adds the `*_scale` specs models.quantize emits: a
+    scale has the weight's shape with axis -2 reduced to 1, so its spec
+    is the weight spec with that component un-sharded)."""
     blocks = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
@@ -50,6 +53,20 @@ def param_pspecs(cfg) -> Dict[str, Any]:
     }
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "tp")
+    if quantized:
+        from seldon_tpu.models.quantize import _BLOCK_WEIGHTS
+
+        def scale_spec(spec: P) -> P:
+            parts = list(spec)
+            parts[-2] = None  # reduced (size-1) axis can't be sharded
+            return P(*parts)
+
+        for name in _BLOCK_WEIGHTS:
+            if name in blocks:
+                blocks[f"{name}_scale"] = scale_spec(blocks[name])
+        specs["embed_scale"] = scale_spec(specs["embed"])
+        if "lm_head" in specs:
+            specs["lm_head_scale"] = scale_spec(specs["lm_head"])
     return specs
 
 

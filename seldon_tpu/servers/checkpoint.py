@@ -48,11 +48,23 @@ def load_checkpoint(path: str, mesh=None):
 
     path = os.path.abspath(path)
     cfg = load_config(path)
-    shape_tree = jax.eval_shape(
-        lambda: transformer.init_params(cfg, jax.random.key(0))
-    )
+
+    def build(key):
+        p = transformer.init_params(cfg, key)
+        if cfg.weight_dtype == "int8":
+            # Saved quantized trees carry int8 leaves + *_scale entries;
+            # the restore skeleton must match (config.json records it).
+            from seldon_tpu.models.quantize import quantize_params
+
+            p = quantize_params(p)
+        return p
+
+    shape_tree = jax.eval_shape(build, jax.random.key(0))
     if mesh is not None:
-        ns = shd.named_shardings(mesh, shd.param_pspecs(cfg))
+        ns = shd.named_shardings(
+            mesh,
+            shd.param_pspecs(cfg, quantized=cfg.weight_dtype == "int8"),
+        )
         shape_tree = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             shape_tree,

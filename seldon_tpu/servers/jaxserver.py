@@ -48,6 +48,7 @@ class JAXServer(SeldonComponent):
         max_seq_len: int = 0,
         init_seed: int = 0,
         warmup: int = 0,
+        weight_dtype: str = "",
     ):
         self.model_uri = model_uri
         self.preset = preset
@@ -55,6 +56,15 @@ class JAXServer(SeldonComponent):
         self.max_seq_len = int(max_seq_len)
         self.init_seed = int(init_seed)
         self.warmup = int(warmup)
+        # Overrides the checkpoint config's weight_dtype: HF checkpoints
+        # are always bf16 on disk, so serving them int8 (the llama3-8b-
+        # on-one-16GB-chip config) is selected HERE (or via the
+        # weight_dtype unit parameter / WEIGHT_DTYPE env).
+        import os as _os
+
+        self.weight_dtype = (
+            weight_dtype or _os.environ.get("WEIGHT_DTYPE", "")
+        )
         self._loaded = False
         self._load_lock = threading.Lock()
         self.engine: Optional[InferenceEngine] = None
@@ -131,6 +141,14 @@ class JAXServer(SeldonComponent):
                             mesh, shd.param_pspecs(cfg)
                         ),
                     )(jax.random.key(self.init_seed))
+            if self.weight_dtype:
+                import dataclasses as _dc
+
+                cfg = _dc.replace(cfg, weight_dtype=self.weight_dtype)
+            if cfg.weight_dtype == "int8":
+                from seldon_tpu.models.quantize import quantize_params
+
+                params = quantize_params(params)
             self.cfg = cfg
             self.mesh = mesh
             seq = self.max_seq_len or cfg.max_seq_len
