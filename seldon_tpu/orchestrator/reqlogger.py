@@ -198,11 +198,15 @@ def build_sink_app(store=None, echo: bool = False):
         return web.json_response({"ingested": len(flat)})
 
     async def dump(request: web.Request) -> web.Response:
-        return web.json_response(docs[-1000:])
+        return web.json_response(list(docs)[-1000:])
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"docs": len(docs)})
 
     app = web.Application()
     app.router.add_post("/", handle)
     app.router.add_get("/dump", dump)
+    app.router.add_get("/healthz", healthz)
     app["docs"] = docs
     return app
 
@@ -257,3 +261,41 @@ def _flatten(body: dict, ce_type: str, puid: str, headers: dict):
             doc["row"] = row
         docs.append(doc)
     return docs
+
+
+def main(argv=None) -> None:  # pragma: no cover - CLI entry
+    """Run the flattening sink standalone (reference
+    seldon-request-logger container): engines POST CloudEvents here.
+    The durable output is the stdout echo (fluentd/ELK pick it up); the
+    in-memory store is a BOUNDED ring so a long-lived pod can't OOM."""
+    import argparse
+    import collections
+
+    from aiohttp import web
+
+    parser = argparse.ArgumentParser(description="seldon-tpu request logger")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("PORT", "8080")))
+    parser.add_argument("--quiet", action="store_true",
+                        help="don't echo flattened docs to stdout")
+    parser.add_argument("--keep", type=int, default=1000,
+                        help="docs retained for /dump")
+    args = parser.parse_args(argv)
+
+    async def run():
+        store = collections.deque(maxlen=args.keep)
+        runner = web.AppRunner(
+            build_sink_app(store=store, echo=not args.quiet)
+        )
+        await runner.setup()
+        await web.TCPSite(runner, "0.0.0.0", args.port).start()
+        logger.info("request-logger sink on :%d", args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
