@@ -44,6 +44,19 @@ class ModelConfig:
     # footprint (llama3-8b on one 16GB v5e chip needs this). Applied by
     # loaders via quantize_params; compute stays bf16.
     weight_dtype: str = "bf16"
+    # RoPE frequency scaling (long-context checkpoints). Flat scalar
+    # fields rather than a dict so the frozen config stays hashable.
+    # rope_scaling_type: None (no scaling), "linear" (inv_freq / factor),
+    # or "llama3" (HF _compute_llama3_parameters: wavelengths past the
+    # original context window are divided by `factor`, with a smooth
+    # ramp between the low/high frequency knees). Llama-3.1/3.2
+    # checkpoints declare rope_type=llama3 — ignoring it would produce
+    # subtly wrong logits at every position.
+    rope_scaling_type: Optional[str] = None
+    rope_scaling_factor: float = 1.0
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max_position: int = 8192
 
     @property
     def head_dim(self) -> int:
@@ -64,6 +77,9 @@ class ModelConfig:
         )
         assert self.weight_dtype in ("bf16", "int8"), (
             f"unknown weight_dtype {self.weight_dtype!r}"
+        )
+        assert self.rope_scaling_type in (None, "linear", "llama3"), (
+            f"unknown rope_scaling_type {self.rope_scaling_type!r}"
         )
         if self.n_experts:
             assert self.n_experts_per_token <= self.n_experts

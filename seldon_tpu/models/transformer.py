@@ -117,7 +117,35 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 def rope_frequencies(cfg: ModelConfig) -> jnp.ndarray:
     half = cfg.head_dim // 2
-    return 1.0 / (cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
+    )
+    if cfg.rope_scaling_type == "linear":
+        return inv_freq / cfg.rope_scaling_factor
+    if cfg.rope_scaling_type == "llama3":
+        # HF transformers' _compute_llama3_parameters: frequencies whose
+        # wavelength exceeds the ORIGINAL context window are slowed by
+        # `factor`; those well inside it are untouched; a smooth ramp
+        # (parameterized by the low/high frequency knees) interpolates.
+        factor = cfg.rope_scaling_factor
+        lo_f = cfg.rope_scaling_low_freq_factor
+        hi_f = cfg.rope_scaling_high_freq_factor
+        old_ctx = cfg.rope_scaling_original_max_position
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = old_ctx / lo_f
+        high_wavelen = old_ctx / hi_f
+        smooth = (old_ctx / wavelen - lo_f) / (hi_f - lo_f)
+        scaled = jnp.where(
+            wavelen > low_wavelen,
+            inv_freq / factor,
+            jnp.where(
+                wavelen < high_wavelen,
+                inv_freq,
+                (1.0 - smooth) * inv_freq / factor + smooth * inv_freq,
+            ),
+        )
+        return scaled
+    return inv_freq
 
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray):

@@ -121,12 +121,17 @@ class RequestLogger:
         while True:
             ce_type, raw, puid = await self._queue.get()
             body = payloads.message_to_dict(pb.SeldonMessage.FromString(raw))
+            # CloudEvents ids must be unique per (source, id): dedup-capable
+            # sinks drop one of a same-id pair, losing half the record. The
+            # request/response correlation rides Ce-Requestid (= puid),
+            # matching the reference logger's scheme.
+            kind = "request" if ce_type == CE_TYPE_REQUEST else "response"
             headers = {
                 "Content-Type": "application/json",
                 "CE-SpecVersion": "0.2",
                 "CE-Type": ce_type,
                 "CE-Source": "seldon-tpu-engine",
-                "CE-Id": puid,
+                "CE-Id": f"{puid}-{kind}",
                 "CE-Time": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
                 ),

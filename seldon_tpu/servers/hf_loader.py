@@ -28,6 +28,42 @@ from seldon_tpu.models.config import ModelConfig
 logger = logging.getLogger(__name__)
 
 
+def _rope_scaling_fields(hf: Dict[str, Any]) -> Dict[str, Any]:
+    """Map HF `rope_scaling` (Llama-3.1/3.2 long-context checkpoints)
+    onto ModelConfig's flat rope_scaling_* fields. Unknown schemes raise
+    rather than silently producing wrong logits at every position."""
+    rs = hf.get("rope_scaling")
+    if not rs:
+        return {}
+    # HF renamed "type" -> "rope_type" across versions; accept both.
+    rtype = rs.get("rope_type", rs.get("type"))
+    if rtype == "default":
+        return {}
+    if rtype == "linear":
+        return {
+            "rope_scaling_type": "linear",
+            "rope_scaling_factor": float(rs["factor"]),
+        }
+    if rtype == "llama3":
+        return {
+            "rope_scaling_type": "llama3",
+            "rope_scaling_factor": float(rs["factor"]),
+            "rope_scaling_low_freq_factor": float(
+                rs.get("low_freq_factor", 1.0)
+            ),
+            "rope_scaling_high_freq_factor": float(
+                rs.get("high_freq_factor", 4.0)
+            ),
+            "rope_scaling_original_max_position": int(
+                rs.get("original_max_position_embeddings", 8192)
+            ),
+        }
+    raise ValueError(
+        f"unsupported rope_scaling {rs!r}; this loader implements "
+        "'linear' and 'llama3' frequency scaling"
+    )
+
+
 def config_from_hf(hf: Dict[str, Any]) -> ModelConfig:
     """ModelConfig from an HF llama config.json dict."""
     mt = hf.get("model_type", "llama")
@@ -37,6 +73,7 @@ def config_from_hf(hf: Dict[str, Any]) -> ModelConfig:
             "Llama family (llama, mistral)"
         )
     return ModelConfig(
+        **_rope_scaling_fields(hf),
         vocab_size=hf["vocab_size"],
         d_model=hf["hidden_size"],
         n_layers=hf["num_hidden_layers"],

@@ -12,8 +12,11 @@ crash)."""
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import tempfile
+
+logger = logging.getLogger(__name__)
 
 _DOWNLOAD_DIR = os.environ.get("SELDON_TPU_MODEL_DIR", "/mnt/models")
 
@@ -56,15 +59,33 @@ def _target_dir(out_dir: str | None) -> str:
 
 def _relative_key(key: str, prefix: str) -> str | None:
     """Path of `key` under `prefix`, or None if key is outside it (guards
-    against 'models/a' string-matching 'models/ab/...')."""
+    against 'models/a' string-matching 'models/ab/...').
+
+    Also rejects keys whose relative path would escape the download dir
+    (absolute components or `..` segments) — bucket listings are remote
+    input, and `os.path.join(target, rel)` must never write outside
+    `target` even against a hostile/compromised storage account. All
+    three listing backends (gs/s3/azure) route through here."""
     if not prefix:
-        return key
-    p = prefix.rstrip("/")
-    if key == p:
-        return os.path.basename(key)
-    if key.startswith(p + "/"):
-        return key[len(p) + 1:]
-    return None
+        rel = key
+    else:
+        p = prefix.rstrip("/")
+        if key == p:
+            rel = os.path.basename(key)
+        elif key.startswith(p + "/"):
+            rel = key[len(p) + 1:]
+        else:
+            return None
+    # Empty rel and trailing-slash rels are directory markers (console
+    # -created 'folder' placeholders) — skip, or the per-blob open() on a
+    # directory path aborts the whole download.
+    if not rel or rel.endswith("/"):
+        return None
+    parts = rel.split("/")
+    if rel.startswith("/") or ".." in parts or any("\\" in s for s in parts):
+        logger.warning("skipping traversal-unsafe object key %r", key)
+        return None
+    return rel
 
 
 def _download_gcs(uri: str, out_dir: str | None) -> str:

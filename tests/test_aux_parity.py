@@ -120,6 +120,24 @@ def test_azure_blob_prefix_download(fake_http, tmp_path):
     assert open(f"{local}/weights.bin", "rb").read() == b"\x00\x01\x02"
 
 
+def test_relative_key_rejects_traversal():
+    """Listing-supplied object keys are remote input: keys that would
+    escape the download dir (.. segments, absolute paths, backslashes)
+    must be skipped across all backends (gs/s3/azure all route here)."""
+    from seldon_tpu.servers.storage import _relative_key
+
+    assert _relative_key("models/demo/a/b.bin", "models/demo") == "a/b.bin"
+    assert _relative_key("models/demo/../../etc/passwd", "models/demo") is None
+    assert _relative_key("../evil", "") is None
+    assert _relative_key("/etc/passwd", "") is None
+    assert _relative_key("models/demo/..", "models/demo") is None
+    assert _relative_key(r"models/demo/a\..\..\x", "models/demo") is None
+    # Directory-marker placeholders (console-created 'folders') skip.
+    assert _relative_key("models/demo/sub/", "models/demo") is None
+    # Prefix mismatch still guarded.
+    assert _relative_key("models/demo2/a", "models/demo") is None
+
+
 # ---------------------------------------------------------------------------
 # SageMaker proxy
 # ---------------------------------------------------------------------------

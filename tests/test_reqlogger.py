@@ -90,6 +90,46 @@ def test_engine_pair_reaches_sink_and_flattens():
     assert req_doc["deployment"] == "dep" and req_doc["predictor"] == "pred"
 
 
+def test_ce_ids_unique_per_event():
+    """CloudEvents ids must differ between the request and response of one
+    prediction (dedup-capable sinks drop same-id pairs); correlation rides
+    Ce-Requestid instead."""
+
+    async def run():
+        seen = []
+
+        async def handle(request):
+            seen.append(dict(request.headers))
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_post("/", handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        rl = RequestLogger(sink_url=f"http://127.0.0.1:{port}/")
+        msg = payloads.build_message(np.ones((1, 1), np.float32))
+        rl.log_pair(msg, msg, "puid-7")
+        for _ in range(100):
+            if rl.sent >= 2:
+                break
+            await asyncio.sleep(0.02)
+        await rl.close()
+        await runner.cleanup()
+        return seen
+
+    seen = asyncio.run(run())
+    ids = sorted(h["CE-Id"] for h in seen)
+    assert ids == ["puid-7-request", "puid-7-response"]
+    assert all(h["Ce-Requestid"] == "puid-7" for h in seen)
+    types = {h["CE-Id"]: h["CE-Type"] for h in seen}
+    assert types["puid-7-request"] == CE_TYPE_REQUEST
+    assert types["puid-7-response"] == CE_TYPE_RESPONSE
+
+
 def test_disabled_logger_is_free():
     rl = RequestLogger(sink_url="", log_requests=False, log_responses=False)
     assert not rl.enabled
