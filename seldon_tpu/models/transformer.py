@@ -178,6 +178,66 @@ def gqa_attention(
     return out.reshape(B, Sq, H * Dh)
 
 
+def gqa_attention_decode(
+    q: jnp.ndarray,  # [B, 1, H, Dh]
+    ck: jnp.ndarray,  # [B, Hkv, T, Dh] OLD cache (pre-write; int8 if scales)
+    cv: jnp.ndarray,  # [B, Hkv, T, Dh]
+    k_fresh: jnp.ndarray,  # [B, 1, Hkv, Dh] bf16 (exact, this token)
+    v_fresh: jnp.ndarray,  # [B, 1, Hkv, Dh]
+    mask_lt: jnp.ndarray,  # [B, 1, T] True where t < pos (strict)
+    k_scale: Optional[jnp.ndarray] = None,  # [B, Hkv, T] f32 (int8 cache)
+    v_scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Decode attention over the PRE-write head-major cache plus a
+    fresh-token column.
+
+    Why pre-write: scattering this step's k/v into the carried cache and
+    slice-reading it back defeats XLA's operand fusion — the read-after-
+    write materializes a copy of the whole [B,*,T,Dh] layer (measured 2x
+    attention cost at [160, 257] on v5e). Reading the OLD cache (no data
+    dependency on the write) fuses; the current token rides as one exact
+    bf16 column appended to the score matrix, and cache writes happen
+    OUTSIDE the layer scan in one batched scatter.
+
+    Why head-major [B,Hkv,T,Dh]: it is the layout the attention einsums
+    want; storing token-major made XLA insert a per-layer transpose copy
+    of every slice (seen in HLO as bf16[1,B,T,Hkv,Dh]{4,2,3,1,0} copies).
+
+    For int8 caches the per-(token, head) scales are factored OUT of the
+    einsums — scores = (q . k_q) * k_scale, out = (w * v_scale) . v_q —
+    so the HBM read stays 1 byte/element (dequantizing first re-widens
+    the operand: measured int8 bought only 3% that way). int8 values are
+    exact in bf16 and scales apply in f32, so rounding is strictly
+    tighter than dequantize-then-multiply. The fresh column is exact
+    bf16 — requantization noise only enters through PAST tokens."""
+    B, S, H, Dh = q.shape
+    Hkv = ck.shape[1]
+    G = H // Hkv
+    qr = q.reshape(B, S, Hkv, G, Dh)
+    scores = jnp.einsum(
+        "bskgd,bktd->bkgst", qr, ck.astype(qr.dtype),
+        preferred_element_type=jnp.float32,
+    ) / (Dh**0.5)
+    if k_scale is not None:
+        scores = scores * k_scale[:, :, None, None, :]
+    s_fresh = jnp.einsum(
+        "bskgd,bukd->bkgsu", qr, k_fresh.astype(qr.dtype),
+        preferred_element_type=jnp.float32,
+    ) / (Dh**0.5)
+    scores = jnp.where(mask_lt[:, None, None, :, :], scores, -1e30)
+    full = jnp.concatenate([scores, s_fresh], axis=-1)  # [B,k,g,1,T+1]
+    w = jax.nn.softmax(full.astype(jnp.float32), axis=-1)
+    wc, wf = w[..., :-1], w[..., -1:]
+    if v_scale is not None:
+        wc = wc * v_scale[:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgst,bktd->bskgd", wc.astype(qr.dtype), cv.astype(qr.dtype)
+    ) + jnp.einsum(
+        "bkgsu,bukd->bskgd", wf.astype(qr.dtype), v_fresh.astype(qr.dtype)
+    )
+    return out.reshape(B, S, H * Dh)
+
+
 def swiglu(x, w_gate, w_up, w_down):
     return jnp.einsum(
         "bsf,fd->bsd", jax.nn.silu(jnp.einsum("bsd,df->bsf", x, w_gate))
@@ -231,59 +291,6 @@ def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale
 
 
-def _write_cache(cache: Cache, li, k, v, write_pos, quantized: bool,
-                 whole_window: bool) -> Cache:
-    """Scatter fresh k/v (bf16 [B,S,Hkv,Dh]) into layer `li` of the full
-    carried token-major cache ([L,B,T,Hkv,...]). Quantized caches also
-    write the per-slot scales (same leading layout minus Dh)."""
-    if quantized:
-        kq, ks = _quantize_kv(k)
-        vq, vs = _quantize_kv(v)
-        writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
-    else:
-        writes = {"k": k.astype(cache["k"].dtype),
-                  "v": v.astype(cache["v"].dtype)}
-    out = dict(cache)
-    if whole_window:
-        for key, val in writes.items():
-            out[key] = jax.lax.dynamic_update_index_in_dim(
-                cache[key], val.astype(cache[key].dtype), li, 0
-            )
-        return out
-    B, S = k.shape[0], k.shape[1]
-    rows = jnp.arange(B)
-    idx = write_pos[:, None] + jnp.arange(S)[None, :]  # [B,S]
-    for key, val in writes.items():
-        # Row indices are arange: sorted/unique flags keep XLA off the
-        # serializing general-scatter path; per-(b,t) payloads are
-        # contiguous [Hkv, ...] chunks in this layout.
-        out[key] = cache[key].at[li, rows[:, None], idx].set(
-            val.astype(cache[key].dtype),
-            indices_are_sorted=True, unique_indices=True,
-        )
-    return out
-
-
-def _read_layer_kv(cache: Cache, li, compute_dtype,
-                   quantized: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """This layer's [B,T,Hkv,Dh] k/v view, dequantized to compute dtype.
-    The HBM read is int8 when quantized (the point); the dequant multiply
-    happens on-chip and fuses into the attention einsum."""
-    ck = jax.lax.dynamic_index_in_dim(cache["k"], li, 0, keepdims=False)
-    cv = jax.lax.dynamic_index_in_dim(cache["v"], li, 0, keepdims=False)
-    if quantized:
-        ks = jax.lax.dynamic_index_in_dim(
-            cache["k_scale"], li, 0, keepdims=False
-        )
-        vs = jax.lax.dynamic_index_in_dim(
-            cache["v_scale"], li, 0, keepdims=False
-        )
-        ck = ck.astype(compute_dtype) * ks[..., None].astype(compute_dtype)
-        cv = cv.astype(compute_dtype) * vs[..., None].astype(compute_dtype)
-        return ck, cv
-    return ck.astype(compute_dtype), cv.astype(compute_dtype)
-
-
 def _block(
     x: jnp.ndarray,
     bp: Dict[str, jnp.ndarray],
@@ -291,47 +298,21 @@ def _block(
     positions: jnp.ndarray,
     inv_freq: jnp.ndarray,
     mask: jnp.ndarray,
-    write_pos: Optional[jnp.ndarray] = None,
     act_spec: Optional[P] = None,
-    full_cache: Optional[Tuple[Cache, jnp.ndarray]] = None,
     ring_mesh=None,
-    decode_kernel: bool = False,
 ):
-    """One transformer block.
-
-    Cached attention carries the WHOLE cache dict (arrays [L, B, W, ...])
-    through the layer scan as `full_cache=(cache, layer_idx)`: fresh k/v
-    are scattered into layer_idx's slots IN PLACE (donated carry) and
-    only the touched slots are written — rebuilding the cache as scan ys
-    measured ~40% of decode-step time at [96 slots, 257 window] on v5e.
-    With cfg.kv_cache_dtype == "int8", slots store per-(token, head)
-    symmetric int8 + scales, halving the cache read per decoded token."""
-    B, S, D = x.shape
-    Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
-    quantized = cfg.kv_cache_dtype == "int8"
-
+    """One CACHE-FREE transformer block (training / scoring / ring).
+    Serving paths live in _run_blocks_prefill / _run_blocks_decode."""
+    B, S, _ = x.shape
+    Dh = cfg.head_dim
     h = rms_norm(x, bp["attn_norm"], cfg.rms_norm_eps)
-    q = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wq", h.dtype)).reshape(B, S, cfg.n_heads, Dh)
-    k = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wk", h.dtype)).reshape(B, S, Hkv, Dh)
-    v = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wv", h.dtype)).reshape(B, S, Hkv, Dh)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
 
-    window = full_cache[0]["k"].shape[2] if full_cache is not None else None
-    # Flash covers the no-cache path AND whole-window cached prefill (the
-    # serving path: the sub-cache window equals the prompt bucket, so
-    # attention is causal over the fresh k/v and the cache write is just the
-    # fresh k/v themselves — no cache read needed).
-    use_flash = cfg.attn_impl == "flash" and S > 1 and (
-        window is None or S == window
-    )
+    use_flash = cfg.attn_impl == "flash" and S > 1
     # Ring attention: long-context full-sequence path with the sequence
     # axis sharded over 'sp' — exact attention, k/v blocks rotate over ICI
-    # (parallel/ring_attention.py). Cache-free only: scoring + training.
-    use_ring = (
-        cfg.attn_impl == "ring" and ring_mesh is not None and S > 1
-        and full_cache is None
-    )
+    # (parallel/ring_attention.py).
+    use_ring = cfg.attn_impl == "ring" and ring_mesh is not None and S > 1
 
     if use_ring:
         from seldon_tpu.parallel.ring_attention import ring_attention
@@ -342,7 +323,6 @@ def _block(
         out = ring_attention(q, k_exp, v_exp, ring_mesh, axis="sp",
                              causal=True)
         attn = out.reshape(B, S, cfg.n_heads * Dh)
-        new_kv = None
     elif use_flash:
         # Full-sequence causal path through the pallas flash kernel
         # (ops/flash_attention.py). GQA is native in the kernel: kv stays
@@ -360,50 +340,45 @@ def _block(
             .transpose(0, 2, 1, 3)
             .reshape(B, S, cfg.n_heads * Dh)
         )
-        if full_cache is not None:
-            cache, li = full_cache
-            new_kv = _write_cache(cache, li, k, v, write_pos, quantized,
-                                  whole_window=True)
-        else:
-            new_kv = None
-    elif full_cache is not None:
-        cache, li = full_cache
-        cache = _write_cache(cache, li, k, v, write_pos, quantized,
-                             whole_window=(S == window))
-        if decode_kernel and S == 1:
-            # Pallas decode kernel: full-tile MXU matmuls + in-kernel int8
-            # dequant (ops/decode_attention.py). Single-chip serving path
-            # (pallas doesn't auto-partition under GSPMD).
-            from seldon_tpu.ops.decode_attention import decode_attention
-
-            # The kernel wants head-major [B,Hkv,T,Dh]; the transpose is
-            # a real copy, which is why this path is opt-in (see engine).
-            ck = jax.lax.dynamic_index_in_dim(
-                cache["k"], li, 0, False).transpose(0, 2, 1, 3)
-            cv = jax.lax.dynamic_index_in_dim(
-                cache["v"], li, 0, False).transpose(0, 2, 1, 3)
-            if quantized:
-                ks = jax.lax.dynamic_index_in_dim(
-                    cache["k_scale"], li, 0, False).transpose(0, 2, 1)
-                vs = jax.lax.dynamic_index_in_dim(
-                    cache["v_scale"], li, 0, False).transpose(0, 2, 1)
-            else:
-                ks = vs = None
-            out = decode_attention(
-                q[:, 0], ck, cv, write_pos, k_scale=ks, v_scale=vs
-            )
-            attn = out[:, None].reshape(B, S, cfg.n_heads * Dh)
-        else:
-            ck, cv = _read_layer_kv(cache, li, q.dtype, quantized)
-            attn = gqa_attention(q, ck, cv, mask)
-        new_kv = cache
     else:
         attn = gqa_attention(q, k, v, mask)
-        new_kv = None
 
     x = x + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
+    x, aux = _mlp_res(x, bp, cfg, act_spec)
+    return x, aux
+
+
+def _run_blocks(params, x, cfg, positions, inv_freq, mask,
+                act_spec=None, remat=False, ring_mesh=None):
+    """Cache-free lax.scan over the stacked layer axis."""
+
+    def body(carry, bp):
+        out, aux = _block(carry, bp, cfg, positions, inv_freq, mask,
+                          act_spec=act_spec, ring_mesh=ring_mesh)
+        return out, aux
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, aux = jax.lax.scan(body, x, params["blocks"])
+    return x, None, jnp.mean(aux)
+
+
+def _qkv(h, bp, cfg, positions, inv_freq):
+    B, S, _ = h.shape
+    Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+    q = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wq", h.dtype)).reshape(
+        B, S, cfg.n_heads, Dh)
+    k = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wk", h.dtype)).reshape(
+        B, S, Hkv, Dh)
+    v = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wv", h.dtype)).reshape(
+        B, S, Hkv, Dh)
+    return apply_rope(q, positions, inv_freq), apply_rope(k, positions, inv_freq), v
+
+
+def _mlp_res(x, bp, cfg, act_spec):
+    """Post-attention half of a block: residual + (SwiGLU | MoE)."""
     h = rms_norm(x, bp["mlp_norm"], cfg.rms_norm_eps)
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
@@ -414,44 +389,93 @@ def _block(
                        _w(bp, "w_up", h.dtype), _w(bp, "w_down", h.dtype))
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
-    return x, new_kv, aux
+    return x, aux
 
 
-def _run_blocks(params, x, cfg, positions, inv_freq, mask, cache=None,
-                write_pos=None, act_spec=None, remat=False, ring_mesh=None,
-                decode_kernel=False):
-    """lax.scan over the stacked layer axis."""
+def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
+                        act_spec=None):
+    """Layer scan for PREFILL: attention runs over the fresh k/v only
+    (every serving prefill starts at position 0, so the fresh tokens ARE
+    the whole visible window — the cache is never read) and each layer's
+    rope'd k/v come back as scan ys, stacked [L, B, Hkv, S, Dh], exactly
+    the head-major cache layout. The caller builds/updates the cache from
+    them in ONE operation — no per-layer cache traffic at all. Returns
+    (x, {"k","v"} stacked bf16, aux)."""
 
-    if cache is None:
+    def body(carry, bp):
+        h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        B, S = q.shape[0], q.shape[1]
+        if cfg.attn_impl == "flash" and S > 1:
+            from seldon_tpu.ops.flash_attention import flash_attention
 
-        def body(carry, bp):
-            out, _, aux = _block(carry, bp, cfg, positions, inv_freq, mask,
-                                 act_spec=act_spec, ring_mesh=ring_mesh)
-            return out, aux
+            Dh = cfg.head_dim
 
-        if remat:
-            body = jax.checkpoint(body)
-        x, aux = jax.lax.scan(body, x, params["blocks"])
-        return x, None, jnp.mean(aux)
+            def fold(t):
+                n = t.shape[2]
+                return t.transpose(0, 2, 1, 3).reshape(B * n, S, Dh)
 
-    # Cached path: the FULL cache dict rides the scan carry (in-place slot
-    # scatter per layer) instead of being rebuilt as stacked ys — see
-    # _block's full_cache docstring for the measured cost.
-    L = params["blocks"]["wq"].shape[0]
+            out = flash_attention(fold(q), fold(k), fold(v), causal=True,
+                                  q_per_kv=cfg.q_per_kv)
+            attn = (out.reshape(B, cfg.n_heads, S, Dh)
+                    .transpose(0, 2, 1, 3).reshape(B, S, -1))
+        else:
+            attn = gqa_attention(q, k, v, mask)
+        x = carry + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
+        if act_spec is not None:
+            x = jax.lax.with_sharding_constraint(x, act_spec)
+        x, aux = _mlp_res(x, bp, cfg, act_spec)
+        # ys in cache layout: [B, Hkv, S, Dh] per layer.
+        return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), aux)
 
-    def body(carry, scanned):
-        h, c = carry
-        bp, li = scanned
-        out, c, aux = _block(
-            h, bp, cfg, positions, inv_freq, mask,
-            write_pos=write_pos, act_spec=act_spec,
-            full_cache=(c, li), decode_kernel=decode_kernel,
+    x, (ks, vs, aux) = jax.lax.scan(body, x, params["blocks"])
+    return x, {"k": ks, "v": vs}, jnp.mean(aux)
+
+
+def _run_blocks_decode(params, x, cfg, positions, inv_freq, pos, cache,
+                       act_spec=None):
+    """Layer scan for DECODE: the cache rides the scan as xs (read-only
+    per-layer slices — these FUSE into the attention einsums, unlike
+    slice-reads of a just-scattered carry), attention handles the current
+    token via an exact fresh column (gqa_attention_decode), and all L
+    layers' fresh k/v are written back AFTER the scan in one batched
+    scatter. Returns (x, new_cache, aux)."""
+    quantized = cfg.kv_cache_dtype == "int8"
+    Smax = cache["k"].shape[3]
+    mask_lt = jnp.arange(Smax)[None, None, :] < pos[:, None, None]
+
+    def body(carry, xs):
+        bp, cl = xs
+        h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        attn = gqa_attention_decode(
+            q, cl["k"], cl["v"], k, v, mask_lt,
+            k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
         )
-        return (out, c), aux
+        x = carry + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
+        if act_spec is not None:
+            x = jax.lax.with_sharding_constraint(x, act_spec)
+        x, aux = _mlp_res(x, bp, cfg, act_spec)
+        if quantized:
+            kq, ksc = _quantize_kv(k[:, 0])
+            vq, vsc = _quantize_kv(v[:, 0])
+            fresh = {"k": kq, "v": vq, "k_scale": ksc, "v_scale": vsc}
+        else:
+            dt = cache["k"].dtype
+            fresh = {"k": k[:, 0].astype(dt), "v": v[:, 0].astype(dt)}
+        return x, (fresh, aux)
 
-    (x, new_cache), aux = jax.lax.scan(
-        body, (x, cache), (params["blocks"], jnp.arange(L)),
-    )
+    x, (fresh, aux) = jax.lax.scan(body, x, (params["blocks"], cache))
+    rows = jnp.arange(pos.shape[0])
+    # One scatter covers all layers. k/v are [L,B,Hkv,T,Dh]; advanced
+    # indices (rows on dim 1, pos on dim 3) land in front, so the update
+    # operand is fresh[key] [L,B,Hkv,(Dh)] transposed to [B,L,Hkv,(Dh)].
+    new_cache = {
+        key: cache[key].at[:, rows, :, pos].set(
+            jnp.swapaxes(fresh[key], 0, 1), unique_indices=True
+        )
+        for key in cache
+    }
     return x, new_cache, jnp.mean(aux)
 
 
@@ -506,17 +530,21 @@ def forward(
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> Cache:
-    """KV cache, token-major [L, B, T, Hkv, Dh] (scales [L, B, T, Hkv]).
-    Head-major was measured WORSE end-to-end on v5e: the decode write
-    becomes a 3-index-array scatter (strided [Hkv, Dh] chunks) that XLA
-    serializes, costing far more than the einsum layout gains."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    """KV cache, HEAD-major [L, B, Hkv, T, Dh] (scales [L, B, Hkv, T]).
+
+    Head-major is the layout the decode attention einsums consume; stored
+    token-major, XLA inserted a per-layer transpose copy of every slice
+    (~2x attention cost at [160 slots, 257 window] on v5e). The write
+    side no longer cares about layout: since the cache is read pre-write
+    (gqa_attention_decode), all L layers' fresh k/v land in ONE batched
+    scatter per step (_run_blocks_decode), not L per-layer scatters."""
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     if cfg.kv_cache_dtype == "int8":
         assert dtype is None, (
             "dtype override is meaningless for an int8 cache (slots are "
             "int8 + f32 scales by construction)"
         )
-        sshape = shape[:-1]  # [L, B, T, Hkv]
+        sshape = shape[:-1]  # [L, B, Hkv, T]
         return {
             "k": jnp.zeros(shape, jnp.int8),
             "v": jnp.zeros(shape, jnp.int8),
@@ -544,21 +572,25 @@ def prefill(
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     inv_freq = rope_frequencies(cfg)
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
-    Smax = cache["k"].shape[2]
-    write_pos = jnp.zeros((B,), dtype=jnp.int32)
-    if S == Smax:
-        x, cache, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                                  cache=cache, write_pos=write_pos)
+    Smax = cache["k"].shape[3]
+    # Attention never reads `cache` — prefill starts at position 0, so the
+    # fresh tokens are the entire visible window (_run_blocks_prefill).
+    # The stacked ys land in the cache in one update per array.
+    x, kv, _ = _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask)
+    if cfg.kv_cache_dtype == "int8":
+        kq, ks = _quantize_kv(kv["k"])
+        vq, vs = _quantize_kv(kv["v"])
+        writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
     else:
-        # Write k/v into the leading S slots of the cache.
-        # Write k/v (and scales, for quantized caches) into the leading S
-        # slots; every cache array shares the [L, B, T, ...] layout.
-        sub = {key: arr[:, :, :S] for key, arr in cache.items()}
-        x, sub, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                                cache=sub, write_pos=write_pos)
+        dt = cache["k"].dtype
+        writes = {"k": kv["k"].astype(dt), "v": kv["v"].astype(dt)}
+    if S == Smax:
+        cache = writes
+    else:
+        # T is dim 3 of k/v and the trailing dim of the scales, so one
+        # indexing expression covers every cache array.
         cache = {
-            key: cache[key].at[:, :, :S].set(sub[key])
-            for key in cache
+            key: cache[key].at[:, :, :, :S].set(writes[key]) for key in cache
         }
     # Gather each row's last real hidden state BEFORE the vocab projection:
     # projecting all S positions would materialize [B,S,V] f32 (~4 GB for an
@@ -574,19 +606,11 @@ def decode_step(
     pos: jnp.ndarray,  # [B] int32 positions to write at
     cache: Cache,
     cfg: ModelConfig,
-    decode_kernel: bool = False,
 ) -> Tuple[jnp.ndarray, Cache]:
-    """One autoregressive step. Returns (logits [B, V], updated cache).
-    decode_kernel routes attention through the pallas decode kernel
-    (single-chip TPU serving; the engine sets it from its mesh)."""
-    B = token.shape[0]
-    Smax = cache["k"].shape[2]
+    """One autoregressive step. Returns (logits [B, V], updated cache)."""
     x = _embed_rows(params, token, _dtype(cfg))[:, None, :]  # [B,1,D]
     positions = pos[:, None]
     inv_freq = rope_frequencies(cfg)
-    # Attend to every cache slot <= own position (slot pos is written first).
-    mask = (jnp.arange(Smax)[None, None, :] <= pos[:, None, None])  # [B,1,Smax]
-    x, cache, _ = _run_blocks(params, x, cfg, positions, inv_freq, mask,
-                              cache=cache, write_pos=pos,
-                              decode_kernel=decode_kernel)
+    x, cache, _ = _run_blocks_decode(params, x, cfg, positions, inv_freq,
+                                     pos, cache)
     return _logits(params, x, cfg)[:, 0], cache

@@ -47,6 +47,27 @@ _REST_PATHS = {
 }
 
 
+def identity_headers(unit: PredictiveUnit) -> Dict[str, str]:
+    """Engine -> unit hop-identity headers, the reference's
+    `Seldon-model-name/image/version` contract
+    (InternalPredictionService.java:191-370): downstream logging/tracing
+    systems recover WHICH unit (and which image build) served each hop
+    without parsing the graph. Keys are lowercase so the same dict is
+    valid gRPC metadata (gRPC requires lowercase ASCII keys; HTTP headers
+    are case-insensitive)."""
+    image, sep, version = (unit.image or "").rpartition(":")
+    # A tag colon always follows the last '/': "localhost:5000/img" is an
+    # UNtagged image on a port-qualified registry, and "img@sha256:..." is
+    # a digest ref — in both, the suffix after ':' contains no tag.
+    if not sep or "/" in version or "@" in image:
+        image, version = (unit.image or ""), ""
+    return {
+        "seldon-model-name": unit.name,
+        "seldon-model-image": image,
+        "seldon-model-version": version,
+    }
+
+
 class UnitCallError(Exception):
     def __init__(self, unit: str, method: str, detail: str, status: int = 500):
         super().__init__(f"{unit}.{method}: {detail}")
@@ -111,11 +132,14 @@ class InternalClient:
         """Invoke `method` on the unit's microservice with retries."""
         ep = unit.endpoint or Endpoint()
         last_err: Optional[Exception] = None
+        identity = identity_headers(unit)
         for attempt in range(self.retries + 1):
             try:
                 if ep.type == EndpointType.GRPC:
-                    return await self._call_grpc(ep, method, request)
-                return await self._call_rest(ep, method, request, response_cls)
+                    return await self._call_grpc(ep, method, request, identity)
+                return await self._call_rest(
+                    ep, method, request, response_cls, identity
+                )
             except (grpc.aio.AioRpcError, OSError, asyncio.TimeoutError) as e:
                 last_err = e
                 # Only connection-level failures retry (reference retries on
@@ -141,24 +165,28 @@ class InternalClient:
             detail = f"{last_err.code().name}: {last_err.details()}"
         raise UnitCallError(unit.name, method, detail)
 
-    async def _call_grpc(self, ep: Endpoint, method: str, request):
+    async def _call_grpc(self, ep: Endpoint, method: str, request,
+                         identity: Optional[Dict[str, str]] = None):
         ch = self._channel(ep)
         service, rpc_name = _GRPC_METHODS[method]
         stub = prediction_grpc.STUBS[service](ch)
-        metadata = tuple(tracing.inject_current({}).items()) or None
+        metadata = tuple(
+            tracing.inject_current(dict(identity or {})).items()
+        ) or None
         return await getattr(stub, rpc_name)(
             request, timeout=self.timeout_s, metadata=metadata
         )
 
-    async def _call_rest(self, ep: Endpoint, method: str, request, response_cls):
+    async def _call_rest(self, ep: Endpoint, method: str, request,
+                         response_cls,
+                         identity: Optional[Dict[str, str]] = None):
         session = await self._http_session()
         url = f"http://{ep.service_host}:{ep.service_port}{_REST_PATHS[method]}"
+        headers = {"Content-Type": PROTO_CONTENT_TYPE, **(identity or {})}
         async with session.post(
             url,
             data=request.SerializeToString(),
-            headers=tracing.inject_current(
-                {"Content-Type": PROTO_CONTENT_TYPE}
-            ),
+            headers=tracing.inject_current(headers),
             timeout=self.timeout_s,
         ) as resp:
             body = await resp.read()

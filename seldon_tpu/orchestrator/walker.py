@@ -29,7 +29,11 @@ from typing import Dict, List, Optional
 from google.protobuf import json_format
 
 from seldon_tpu.core import payloads, tracing
-from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
+from seldon_tpu.orchestrator.client import (
+    InternalClient,
+    UnitCallError,
+    identity_headers,
+)
 from seldon_tpu.orchestrator.spec import (
     HARDCODED_IMPLEMENTATIONS,
     PredictiveUnit,
@@ -131,7 +135,8 @@ class PredictorEngine:
         ctx.request_path[unit.name] = unit.image or unit.name
         hard = self._hardcoded.get(unit.name)
         with self.tracer.span(
-            f"unit.{unit.name}", attributes={"unit_type": str(unit.type)}
+            f"unit.{unit.name}",
+            attributes={"unit_type": str(unit.type), **identity_headers(unit)},
         ):
             return await self._walk_unit(msg, unit, hard, ctx)
 

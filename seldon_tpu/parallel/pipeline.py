@@ -55,7 +55,7 @@ def _stage_body(x, blocks_local, cfg: ModelConfig, positions, inv_freq, mask,
     """Run this stage's local layers (a scan over the local slab)."""
 
     def body(carry, bp):
-        out, _, aux = transformer._block(
+        out, aux = transformer._block(
             carry, bp, cfg, positions, inv_freq, mask
         )
         return out, aux

@@ -73,14 +73,14 @@ def param_pspecs(cfg, quantized: bool = False) -> Dict[str, Any]:
 def cache_pspec(cfg=None) -> Any:
     """KV-cache shardings: batch over dp, kv heads over tp.
 
-    Returns a spec DICT matching transformer.init_cache's leaves: k/v
-    [L, B, T, Hkv, Dh] (+ 4-dim k_scale/v_scale [L, B, T, Hkv] for
+    Returns a spec DICT matching transformer.init_cache's head-major
+    leaves: k/v [L, B, Hkv, T, Dh] (+ k_scale/v_scale [L, B, Hkv, T] for
     kv_cache_dtype == "int8" configs). Apply with
     `jax.tree.map(..., cache, cache_pspec(cfg))`."""
-    kv = P(None, "dp", None, "tp", None)
+    kv = P(None, "dp", "tp", None, None)
     specs = {"k": kv, "v": kv}
     if cfg is not None and getattr(cfg, "kv_cache_dtype", "bf16") == "int8":
-        scale = P(None, "dp", None, "tp")
+        scale = P(None, "dp", "tp", None)
         specs.update({"k_scale": scale, "v_scale": scale})
     return specs
 

@@ -304,13 +304,16 @@ def build_grpc_server(
     max_workers: int = 8,
     max_message_bytes: int = 512 * 1024 * 1024,
     metrics: Optional[ServerMetrics] = None,
+    interceptors: Optional[list] = None,
 ) -> grpc.Server:
     options = [
         ("grpc.max_send_message_length", max_message_bytes),
         ("grpc.max_receive_message_length", max_message_bytes),
     ]
     server = grpc.server(
-        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers), options=options
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=options,
+        interceptors=interceptors or (),
     )
     servicer = _UnitServicer(user_obj, metrics)
     for service in (

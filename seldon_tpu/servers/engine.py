@@ -134,32 +134,11 @@ class InferenceEngine:
             functools.partial(self._admit_impl, cfg=self.cfg),
             donate_argnums=(1,),
         )
-        # Pallas decode-attention kernel: EXPERIMENTAL opt-in
-        # (SELDON_TPU_DECODE_KERNEL=1). Measured on v5e it matches XLA's
-        # cache attention standalone but loses in the layer scan: a pallas
-        # operand must be materialized, so the per-layer dynamic slice of
-        # the cache becomes a real 2x84MB copy per layer per step that
-        # XLA's einsum path fuses away. Single-chip + TPU only (pallas
-        # doesn't auto-partition under GSPMD).
-        import os as _os
-
-        from seldon_tpu.ops.decode_attention import _on_tpu
-
-        n_mesh_devices = (
-            1 if mesh is None else int(np.prod(list(mesh.shape.values())))
-        )
-        self._decode_kernel = (
-            _os.environ.get("SELDON_TPU_DECODE_KERNEL", "0") == "1"
-            and n_mesh_devices == 1
-            and _on_tpu()  # same gate the kernel's dispatch uses
-        )
-
         self._jit_chunk = jax.jit(
             functools.partial(
                 self._chunk_impl,
                 cfg=self.cfg,
                 n_steps=max(1, self.ecfg.decode_chunk),
-                decode_kernel=self._decode_kernel,
             ),
             donate_argnums=(1,),
         )
@@ -202,16 +181,18 @@ class InferenceEngine:
         first = sample_per_row(logits, keys, temps, top_ks, top_ps)
 
         cache = state["cache"]
-        Smax = cache["k"].shape[2]
+        Smax = cache["k"].shape[3]
         first_done = (
             (first == cfg.eos_token_id)
             | (max_news <= 1)
             | (plens + 1 >= Smax)
         )
         # Scatter EVERY cache array (k/v + scales for quantized caches —
-        # all share the token-major [L, B, T, ...] leading layout).
+        # all share the head-major [L, B, Hkv, T, ...] layout, with T at
+        # dim 3 of k/v and trailing on the scales, so one indexing
+        # expression covers them all).
         new_cache = {
-            key: cache[key].at[:, slots, :Sb].set(
+            key: cache[key].at[:, slots, :, :Sb].set(
                 sub[key].astype(cache[key].dtype)
             )
             for key in cache
@@ -230,18 +211,17 @@ class InferenceEngine:
         return new_state, first, first_done
 
     @staticmethod
-    def _chunk_impl(params, state, *, cfg, n_steps, decode_kernel=False):
+    def _chunk_impl(params, state, *, cfg, n_steps):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
         value-level: finished rows stop advancing and emit invalid tokens
         until the chunk boundary. Returns (state, toks [K,B], valid [K,B])."""
-        Smax = state["cache"]["k"].shape[2]
+        Smax = state["cache"]["k"].shape[3]
 
         def step(carry, _):
             run = carry["active"]
             logits, cache = transformer.decode_step(
                 params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
-                decode_kernel=decode_kernel,
             )
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)

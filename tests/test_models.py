@@ -183,19 +183,26 @@ def test_train_step_sharded(plan):
 
 
 def test_int8_kv_cache_matches_bf16_decode():
-    """kv_cache_dtype='int8': greedy decode path must match the bf16 cache
-    exactly on tiny geometry (per-token-head symmetric quantization)."""
+    """kv_cache_dtype='int8': teacher-forced decode logits must track the
+    bf16 cache step-by-step (per-token-head symmetric quantization).
+
+    Teacher forcing (same token sequence through both paths) rather than
+    comparing greedy outputs: a random-init tiny model has near-uniform
+    logits where argmax gaps (~1e-3) sit below even well-behaved
+    quantization error, so exact token equality is tie-breaking luck, not
+    a fidelity signal. Per-step relative logit error IS the signal — the
+    measured error of the factored-scale decode path is <0.005/step."""
     import dataclasses
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from seldon_tpu.models import get_config, init_params, transformer
 
     cfg = get_config("tiny")
     params = init_params(cfg, jax.random.key(0))
     prompt = jnp.array([[5, 6, 7, 8]], jnp.int32)
+    forced = [5, 9, 3, 200, 77, 13, 42, 250]
 
     def run(c):
         cache = transformer.init_cache(c, 1, 32)
@@ -205,23 +212,23 @@ def test_int8_kv_cache_matches_bf16_decode():
         logits, cache = transformer.prefill(
             params, prompt, jnp.array([4]), cache, c
         )
-        toks = [int(jnp.argmax(logits[0]))]
+        lgs = [logits]
         pos = jnp.array([4], jnp.int32)
-        for _ in range(8):
+        for t in forced:
             lg, cache = transformer.decode_step(
-                params, jnp.array([toks[-1]], jnp.int32), pos, cache, c
+                params, jnp.array([t], jnp.int32), pos, cache, c
             )
-            toks.append(int(jnp.argmax(lg[0])))
+            lgs.append(lg)
             pos = pos + 1
-        return toks, logits
+        return lgs
 
-    ref_toks, ref_logits = run(cfg)
-    q_toks, q_logits = run(dataclasses.replace(cfg, kv_cache_dtype="int8"))
-    assert q_toks == ref_toks
-    rel = float(jnp.max(jnp.abs(ref_logits - q_logits))) / float(
-        jnp.max(jnp.abs(ref_logits))
-    )
-    assert rel < 0.05, rel
+    ref = run(cfg)
+    quant = run(dataclasses.replace(cfg, kv_cache_dtype="int8"))
+    # Prefill never reads the cache -> exactly equal logits at step 0.
+    assert float(jnp.max(jnp.abs(ref[0] - quant[0]))) == 0.0
+    for i, (a, b) in enumerate(zip(ref[1:], quant[1:])):
+        rel = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
+        assert rel < 0.02, (i, rel)
 
 
 def test_int8_kv_cache_engine_end_to_end():

@@ -250,10 +250,10 @@ def test_decode_attention_kernel_matches_reference():
                                rtol=3e-3, atol=3e-3)
 
 
-def test_decode_step_kernel_flag_matches_plain():
-    """decode_step(decode_kernel=True) must produce identical logits to
-    the plain path (on CPU the kernel dispatch falls back to reference —
-    the flag's plumbing is what's under test)."""
+def test_decode_step_head_major_cache_layout():
+    """decode_step writes the head-major [L, B, Hkv, T, Dh] cache at each
+    row's position in one batched scatter — the written slots must hold
+    exactly the rope'd fresh k/v and no other slot may change."""
     import numpy as np
 
     from seldon_tpu.models import get_config, init_params, transformer
@@ -261,11 +261,15 @@ def test_decode_step_kernel_flag_matches_plain():
     cfg = get_config("tiny")
     params = init_params(cfg, jax.random.key(0))
     cache = transformer.init_cache(cfg, 2, 16)
+    assert cache["k"].shape == (cfg.n_layers, 2, cfg.n_kv_heads, 16,
+                                cfg.head_dim)
+    before = np.asarray(cache["k"])
     tok = jnp.array([3, 4], jnp.int32)
-    pos = jnp.array([0, 0], jnp.int32)
-    lg_a, _ = transformer.decode_step(params, tok, pos, cache, cfg)
-    cache2 = transformer.init_cache(cfg, 2, 16)
-    lg_b, _ = transformer.decode_step(params, tok, pos, cache2, cfg,
-                                      decode_kernel=True)
-    np.testing.assert_allclose(np.asarray(lg_a), np.asarray(lg_b),
-                               rtol=1e-4, atol=1e-4)
+    pos = jnp.array([2, 5], jnp.int32)
+    _, cache = transformer.decode_step(params, tok, pos, cache, cfg)
+    after = np.asarray(cache["k"])
+    changed = np.any(after != before, axis=(0, 2, 4))  # [B, T]
+    for b, p in enumerate([2, 5]):
+        assert changed[b, p], "fresh k must land at the row's position"
+        changed[b, p] = False
+    assert not changed.any(), "no other slot may be touched"

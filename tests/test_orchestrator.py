@@ -470,3 +470,166 @@ def test_multiworker_engine_shares_port():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Engine -> unit identity headers (reference Seldon-model-name/image/version,
+# InternalPredictionService.java:191-370)
+# ---------------------------------------------------------------------------
+
+
+def test_identity_headers_parse_image_tag():
+    from seldon_tpu.orchestrator.client import identity_headers
+
+    u = PredictiveUnit(name="clf", image="repo/img:1.2")
+    assert identity_headers(u) == {
+        "seldon-model-name": "clf",
+        "seldon-model-image": "repo/img",
+        "seldon-model-version": "1.2",
+    }
+    bare = PredictiveUnit(name="clf", image="repo/img")
+    assert identity_headers(bare)["seldon-model-image"] == "repo/img"
+    assert identity_headers(bare)["seldon-model-version"] == ""
+
+
+def test_identity_headers_sent_on_every_rest_hop():
+    """Each engine->unit REST call carries the hop's identity headers."""
+    from aiohttp import web
+
+    from seldon_tpu.core.http import PROTO_CONTENT_TYPE
+
+    seen = {}
+
+    async def go():
+        async def handle(request: web.Request) -> web.Response:
+            seen[request.headers["seldon-model-name"]] = {
+                "image": request.headers.get("seldon-model-image"),
+                "version": request.headers.get("seldon-model-version"),
+            }
+            out = payloads.build_message(np.array([[1.0]]), kind="dense")
+            return web.Response(
+                body=out.SerializeToString(),
+                content_type=PROTO_CONTENT_TYPE.split(";")[0],
+            )
+
+        app = web.Application()
+        app.router.add_post("/predict", handle)
+        app.router.add_post("/transform-input", handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        s = spec_from(
+            {
+                "name": "p",
+                "graph": {
+                    "name": "t",
+                    "type": "TRANSFORMER",
+                    "image": "trans:0.3",
+                    "endpoint": {
+                        "service_host": "127.0.0.1",
+                        "service_port": port,
+                        "type": "REST",
+                    },
+                    "children": [
+                        {
+                            "name": "m",
+                            "type": "MODEL",
+                            "image": "model:0.7",
+                            "endpoint": {
+                                "service_host": "127.0.0.1",
+                                "service_port": port,
+                                "type": "REST",
+                            },
+                        }
+                    ],
+                },
+            }
+        )
+        eng = PredictorEngine(s)
+        req = payloads.build_message(np.array([[1.0, 2.0]]), kind="dense")
+        await eng.predict(req)
+        await eng.close()
+        await runner.cleanup()
+
+    run(go())
+    assert seen == {
+        "t": {"image": "trans", "version": "0.3"},
+        "m": {"image": "model", "version": "0.7"},
+    }
+
+
+def test_identity_headers_registry_port_and_digest():
+    from seldon_tpu.orchestrator.client import identity_headers
+
+    # Untagged image on a port-qualified registry: the ':' belongs to the
+    # registry, not a tag.
+    u = PredictiveUnit(name="m", image="localhost:5000/team/model")
+    assert identity_headers(u) == {
+        "seldon-model-name": "m",
+        "seldon-model-image": "localhost:5000/team/model",
+        "seldon-model-version": "",
+    }
+    # Tagged image on a port-qualified registry.
+    u = PredictiveUnit(name="m", image="localhost:5000/team/model:2.1")
+    h = identity_headers(u)
+    assert h["seldon-model-image"] == "localhost:5000/team/model"
+    assert h["seldon-model-version"] == "2.1"
+    # Digest ref: no tag to extract.
+    u = PredictiveUnit(name="m", image="repo/img@sha256:abc123")
+    h = identity_headers(u)
+    assert h["seldon-model-image"] == "repo/img@sha256:abc123"
+    assert h["seldon-model-version"] == ""
+
+
+def test_identity_metadata_sent_on_grpc_hop():
+    """gRPC hops carry the identity as (lowercase) gRPC metadata, observed
+    by a real server interceptor (build_grpc_server(interceptors=...))."""
+    import grpc as _grpc
+
+    seen = {}
+
+    class MetaInterceptor(_grpc.ServerInterceptor):
+        def intercept_service(self, continuation, details):
+            md = dict(details.invocation_metadata)
+            if "seldon-model-name" in md:
+                seen[md["seldon-model-name"]] = (
+                    md.get("seldon-model-image"),
+                    md.get("seldon-model-version"),
+                )
+            return continuation(details)
+
+    srv = build_grpc_server(
+        FixedModel([[1.0, 2.0]], image="fixed:0.1"),
+        interceptors=[MetaInterceptor()],
+    )
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    s = spec_from(
+        {
+            "name": "p",
+            "graph": {
+                "name": "m",
+                "type": "MODEL",
+                "image": "img:9.9",
+                "endpoint": {
+                    "service_host": "127.0.0.1",
+                    "service_port": port,
+                    "type": "GRPC",
+                },
+            },
+        }
+    )
+    eng = PredictorEngine(s)
+
+    async def go():
+        req = payloads.build_message(np.array([[1.0, 2.0]]), kind="dense")
+        out = await eng.predict(req)
+        await eng.close()
+        return out
+
+    run(go())
+    srv.stop(0)
+    assert seen == {"m": ("img", "9.9")}, seen

@@ -1,0 +1,65 @@
+"""Compile the real decode chunk and report XLA's cost analysis plus any
+large copy/convert ops in the optimized HLO (fusion failures show up as
+full-cache-sized copies)."""
+
+import functools
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from seldon_tpu.models import get_config, init_params, transformer
+from seldon_tpu.models.quantize import quantize_params
+from tools.microbench_decode import chunk_impl, SLOTS, WINDOW, CHUNK
+
+
+def main():
+    kv = sys.argv[1] if len(sys.argv) > 1 else "int8"
+    wd = sys.argv[2] if len(sys.argv) > 2 else "int8"
+    cfg = get_config("bench-1b", kv_cache_dtype=kv, weight_dtype=wd)
+    params = init_params(cfg, jax.random.key(0))
+    if wd == "int8":
+        params = quantize_params(params)
+    B = SLOTS
+    state = {
+        "cache": transformer.init_cache(cfg, B, WINDOW),
+        "last_tok": jnp.ones((B,), jnp.int32),
+        "pos": jnp.full((B,), 128, jnp.int32),
+        "active": jnp.ones((B,), jnp.bool_),
+        "temp": jnp.full((B,), 0.7, jnp.float32),
+        "top_k": jnp.zeros((B,), jnp.int32),
+        "top_p": jnp.ones((B,), jnp.float32),
+        "seeds": jnp.arange(B, dtype=jnp.uint32),
+    }
+    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK),
+                 donate_argnums=(1,))
+    lowered = fn.lower(params, state)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if ca:
+        for key in sorted(ca):
+            if "bytes" in key or "flops" in key or "time" in key:
+                v = ca[key]
+                if isinstance(v, float) and v > 1e6:
+                    print(f"{key}: {v/1e9:.2f} G")
+    txt = compiled.as_text()
+    # find big copies / converts / broadcasts over cache-sized shapes
+    pat = re.compile(r"(copy|convert|transpose)[^\n]*", re.I)
+    sizes = {}
+    for m in re.finditer(r"\n\s*(\S+)\s*=\s*(\w+)\[([\d,]+)\][^\n]*(copy|transpose)\(", txt):
+        shape = m.group(3)
+        n = 1
+        for d in shape.split(","):
+            n *= int(d)
+        if n >= (1 << 22):
+            sizes[f"{m.group(2)}[{shape}] {m.group(4)}"] = sizes.get(
+                f"{m.group(2)}[{shape}] {m.group(4)}", 0) + 1
+    for k, v in sorted(sizes.items(), key=lambda kv: -kv[1]):
+        print(f"BIG {k} x{v}")
+    # fusion count and total size hints
+    print("n_fusions:", txt.count(" fusion("), " n_copy:", txt.count(" copy("))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Microbenchmark: decode-chunk step time for weight/kv dtype combos.
+
+Times ONE jitted decode chunk (the engine's `_chunk_impl` equivalent:
+`decode_chunk` lax.scan steps over all slots) on the bench-1b serving
+shape, isolating the HBM-bound hot loop from scheduler/host effects.
+Usage: python tools/microbench_decode.py [combos...]
+  combo = weights:kv[:attn] e.g. int8:bf16  int8:int8  bf16:bf16
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from seldon_tpu.models import get_config, init_params, transformer
+from seldon_tpu.models.sampling import sample_per_row
+
+PRESET = "bench-1b"
+SLOTS = 160
+WINDOW = 257  # prompt 128 + decode 128 + 1
+CHUNK = 64
+
+
+def chunk_impl(params, state, *, cfg, n_steps):
+    Smax = state["cache"]["k"].shape[2]
+
+    def step(carry, _):
+        run = carry["active"]
+        logits, cache = transformer.decode_step(
+            params, carry["last_tok"], carry["pos"], carry["cache"], cfg
+        )
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
+        )(carry["seeds"], carry["pos"])
+        tok = sample_per_row(
+            logits, keys, carry["temp"],
+            jnp.where(run, carry["top_k"], 0),
+            jnp.where(run, carry["top_p"], 1.0),
+        )
+        tok = jnp.where(run, tok, cfg.pad_token_id)
+        pos = carry["pos"] + run.astype(jnp.int32)
+        new_carry = {
+            **carry,
+            "cache": cache,
+            "last_tok": jnp.where(run, tok, carry["last_tok"]),
+            "pos": pos,
+        }
+        return new_carry, tok
+
+    state, toks = jax.lax.scan(step, state, None, length=n_steps)
+    return state, toks
+
+
+def bench(weights: str, kv: str, attn: str = "xla") -> float:
+    cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
+                     attn_impl=attn)
+    params = init_params(cfg, jax.random.key(0))
+    if weights == "int8":
+        from seldon_tpu.models.quantize import quantize_params
+
+        params = quantize_params(params)
+    B = SLOTS
+    state = {
+        "cache": transformer.init_cache(cfg, B, WINDOW),
+        "last_tok": jnp.ones((B,), jnp.int32),
+        "pos": jnp.full((B,), 128, jnp.int32),
+        "active": jnp.ones((B,), jnp.bool_),
+        "temp": jnp.full((B,), 0.7, jnp.float32),
+        "top_k": jnp.zeros((B,), jnp.int32),
+        "top_p": jnp.ones((B,), jnp.float32),
+        "seeds": jnp.arange(B, dtype=jnp.uint32),
+    }
+    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK),
+                 donate_argnums=(1,))
+
+    def one(state):
+        # Reset pos each chain link so the window stays comparable.
+        state = dict(state)
+        state["pos"] = jnp.full((B,), 128, jnp.int32)
+        state["active"] = jnp.ones((B,), jnp.bool_)
+        state, toks = fn(params, state)
+        return state
+
+    from tools.timing import slope_time
+
+    dt, _ = slope_time(one, state, k1=2, k2=6)
+    ms_per_step = 1000.0 * dt / CHUNK
+    toks_per_s = SLOTS * CHUNK / dt
+    print(
+        f"w={weights:5s} kv={kv:5s} attn={attn:5s} "
+        f"{ms_per_step:7.3f} ms/step  {toks_per_s:9.0f} tok/s",
+        flush=True,
+    )
+    return ms_per_step
+
+
+if __name__ == "__main__":
+    combos = sys.argv[1:] or ["int8:bf16", "int8:int8", "bf16:bf16", "bf16:int8"]
+    for c in combos:
+        parts = c.split(":")
+        bench(*parts)

@@ -1,0 +1,67 @@
+"""Measure achievable HBM bandwidth on this chip: sum-reduce (pure read)
+and scaled copy (read+write) over large arrays, bf16 and int8."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+N = 1 << 30  # 1Gi elements
+
+
+def timeit(fn, *args):
+    _ = jax.device_get(fn(*args))
+    n = 5
+    t0 = time.perf_counter()
+    for _ in range(n):
+        _ = jax.device_get(fn(*args))
+    return (time.perf_counter() - t0) / n
+
+
+@jax.jit
+def red_bf16(x):
+    return x.astype(jnp.float32).sum()
+
+
+@jax.jit
+def red_int8(x):
+    return x.astype(jnp.int32).sum()
+
+
+@jax.jit
+def mm_bf16(a, w):
+    return jnp.einsum("bd,df->bf", a, w)
+
+
+@jax.jit
+def mm_int8w(a, w):
+    return jnp.einsum("bd,df->bf", a, w.astype(a.dtype))
+
+
+def main():
+    x = jnp.ones((N,), jnp.bfloat16)
+    dt = timeit(red_bf16, x)
+    print(f"read bf16  2GiB: {dt*1000:7.2f} ms  {2/dt:7.1f} GB/s", flush=True)
+    xi = jnp.ones((N,), jnp.int8)
+    dt = timeit(red_int8, xi)
+    print(f"read int8  1GiB: {dt*1000:7.2f} ms  {1/dt:7.1f} GB/s", flush=True)
+    del x, xi
+    # One big matmul at serving batch: [160, 8192] x [8192, 65536]
+    B, D, F = 160, 8192, 65536  # 0.5G weights -> 1GiB bf16
+    a = jnp.ones((B, D), jnp.bfloat16)
+    w = jnp.ones((D, F), jnp.bfloat16)
+    dt = timeit(mm_bf16, a, w)
+    print(f"mm bf16 [160x8k x 8kx64k] 1GiB w: {dt*1000:7.2f} ms  {1.0/dt:7.1f} GB/s", flush=True)
+    wq = jnp.ones((D, F), jnp.int8)
+    dt = timeit(mm_int8w, a, wq)
+    print(f"mm int8w same shape      0.5GiB w: {dt*1000:7.2f} ms  {0.5/dt:7.1f} GB/s", flush=True)
+    # Bigger token batch (1024) to see if MXU grain changes BW
+    a = jnp.ones((1024, D), jnp.bfloat16)
+    dt = timeit(mm_bf16, a, w)
+    print(f"mm bf16 [1024x8k x 8kx64k]: {dt*1000:7.2f} ms  {1.0/dt:7.1f} GB/s", flush=True)
+    dt = timeit(mm_int8w, a, wq)
+    print(f"mm int8w [1024]:           {dt*1000:7.2f} ms  {0.5/dt:7.1f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,322 @@
+"""Probe: does XLA fuse int8 weight dequant into the matmul, and is
+output-side scaling faster? Times a 16-layer scan of the bench-1b MLP
+stack three ways: bf16 weights, dequant-then-dot, dot-then-scale."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+B, D, F, L = 160, 2048, 5632, 16
+CHUNK = 32
+
+
+def mk(key):
+    ks = jax.random.split(key, 3 * L)
+    wg = jax.random.normal(ks[0], (L, D, F), jnp.float32) * 0.02
+    wu = jax.random.normal(ks[1], (L, D, F), jnp.float32) * 0.02
+    wd = jax.random.normal(ks[2], (L, F, D), jnp.float32) * 0.02
+    return wg, wu, wd
+
+
+def quant(w):
+    s = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    return jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8), s
+
+
+def run(name, layer_fn, weights):
+    @jax.jit
+    def f(x, weights):
+        def step(x, _):
+            def body(h, ws):
+                return layer_fn(h, ws), ()
+            h, _ = jax.lax.scan(body, x, weights)
+            return h * 1e-3 + x[0, 0] * 0, ()
+        x, _ = jax.lax.scan(step, x, None, length=CHUNK)
+        return x
+
+    from tools.timing import slope_time
+
+    x = jnp.ones((B, 1, D), jnp.bfloat16)
+    dt, _ = slope_time(lambda s: f(s, weights), x, k1=2, k2=8)
+    print(f"{name:12s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+
+
+def main():
+    wg, wu, wd = mk(jax.random.key(0))
+    bf = (wg.astype(jnp.bfloat16), wu.astype(jnp.bfloat16),
+          wd.astype(jnp.bfloat16))
+    (wgq, sg), (wuq, su), (wdq, sd) = quant(wg), quant(wu), quant(wd)
+
+    def layer_bf16(h, ws):
+        g, u, d = ws
+        return h + jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.silu(jnp.einsum("bsd,df->bsf", h, g))
+            * jnp.einsum("bsd,df->bsf", h, u), d)
+
+    def layer_deq(h, ws):
+        g, sg, u, su, d, sd = ws
+        gd = g.astype(h.dtype) * sg.astype(h.dtype)
+        ud = u.astype(h.dtype) * su.astype(h.dtype)
+        dd = d.astype(h.dtype) * sd.astype(h.dtype)
+        return layer_bf16(h, (gd, ud, dd))
+
+    def layer_outscale(h, ws):
+        g, sg, u, su, d, sd = ws
+        hid = jax.nn.silu(
+            jnp.einsum("bsd,df->bsf", h, g.astype(h.dtype)) * sg.astype(h.dtype)
+        ) * (jnp.einsum("bsd,df->bsf", h, u.astype(h.dtype)) * su.astype(h.dtype))
+        return h + jnp.einsum("bsf,fd->bsd", hid, d.astype(h.dtype)) * sd.astype(h.dtype)
+
+    run("bf16", layer_bf16, bf)
+    run("deq-then-mm", layer_deq, (wgq, sg, wuq, su, wdq, sd))
+    run("mm-then-sc", layer_outscale, (wgq, sg, wuq, su, wdq, sd))
+    attn_probe()
+
+
+def attn_probe():
+    """Cache-attention strategies at serving shape [160 slots, 257 win]."""
+    import jax.numpy as jnp
+
+    B2, T, Hkv, G, Dh, L2 = 160, 257, 8, 2, 128, 16
+    q = jax.random.normal(jax.random.key(1), (B2, 1, Hkv, G, Dh), jnp.bfloat16)
+    kbf = jax.random.normal(jax.random.key(2), (L2, B2, T, Hkv, Dh), jnp.bfloat16)
+    vbf = jax.random.normal(jax.random.key(3), (L2, B2, T, Hkv, Dh), jnp.bfloat16)
+    ki = jnp.clip(jnp.round(kbf.astype(jnp.float32) * 50), -127, 127).astype(jnp.int8)
+    vi = jnp.clip(jnp.round(vbf.astype(jnp.float32) * 50), -127, 127).astype(jnp.int8)
+    ks = jnp.ones((L2, B2, T, Hkv), jnp.float32) / 50
+    vs = jnp.ones((L2, B2, T, Hkv), jnp.float32) / 50
+    mask = jnp.arange(T)[None, None, :] <= 128
+
+    def attend(qx, ck, cv):
+        scores = jnp.einsum("bskgd,btkd->bkgst", qx, ck,
+                            preferred_element_type=jnp.float32) / Dh**0.5
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(qx.dtype)
+        return jnp.einsum("bkgst,btkd->bskgd", w, cv)
+
+    def run2(name, fn, *ops):
+        from tools.timing import slope_time
+
+        @jax.jit
+        def f(q, *ops):
+            def step(q, _):
+                def layer(a, sl):
+                    return a + fn(q, *sl) * 1e-3, ()
+                a, _ = jax.lax.scan(layer, q, ops)
+                return a, ()
+            q, _ = jax.lax.scan(step, q, None, length=CHUNK)
+            return q
+
+        dt, _ = slope_time(lambda s: f(s, *ops), q, k1=2, k2=8)
+        print(f"attn {name:14s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+
+    # 1) bf16 cache
+    run2("bf16", lambda qx, ck, cv: attend(qx, ck, cv), kbf, vbf)
+    # 2) int8: dequant then attend (materializing?)
+    run2("int8-deq",
+         lambda qx, ck, cs, cv, vs_: attend(
+             qx,
+             ck.astype(qx.dtype) * cs[..., None].astype(qx.dtype),
+             cv.astype(qx.dtype) * vs_[..., None].astype(qx.dtype)),
+         ki, ks, vi, vs)
+    # 3) int8: factored scales (convert-only operands)
+    def factored(qx, ck, cs, cv, vs_):
+        scores = jnp.einsum("bskgd,btkd->bkgst", qx, ck.astype(qx.dtype),
+                            preferred_element_type=jnp.float32) / Dh**0.5
+        scores = scores * cs.transpose(0, 2, 1)[:, :, None, None, :]
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        wv = (w * vs_.transpose(0, 2, 1)[:, :, None, None, :]).astype(qx.dtype)
+        return jnp.einsum("bkgst,btkd->bskgd", wv, cv.astype(qx.dtype))
+    run2("int8-factored", factored, ki, ks, vi, vs)
+    # 4) int8 via direct int8 dot (int32 accum) then scale
+    def int8dot(qx, ck, cs, cv, vs_):
+        qs = jnp.max(jnp.abs(qx.astype(jnp.float32)), axis=-1) / 127.0
+        qi = jnp.clip(jnp.round(qx.astype(jnp.float32) / qs[..., None]),
+                      -127, 127).astype(jnp.int8)
+        raw = jnp.einsum("bskgd,btkd->bkgst", qi, ck,
+                         preferred_element_type=jnp.int32)
+        scores = raw.astype(jnp.float32)
+        scores = scores * (qs.transpose(0, 2, 3, 1)[..., None]
+                           * cs.transpose(0, 2, 1)[:, :, None, None, :]) / Dh**0.5
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        wv = (w * vs_.transpose(0, 2, 1)[:, :, None, None, :]).astype(jnp.bfloat16)
+        return jnp.einsum("bkgst,btkd->bskgd", wv, cv.astype(jnp.bfloat16))
+    run2("int8-qdot", int8dot, ki, ks, vi, vs)
+
+    # 5/6) REAL structure: cache rides the scan carry; per layer we
+    # scatter-write the fresh token then dynamic-slice-read for attention.
+    from tools.timing import slope_time
+
+    pos = jnp.full((B2,), 128, jnp.int32)
+    rows = jnp.arange(B2)
+    qflat = q
+
+    def carry_probe(name, cache, quant):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def f(cache, q):
+            def step(carry, _):
+                c, acc = carry
+                kf = jax.random.normal(jax.random.key(9), (B2, 1, Hkv, Dh),
+                                       jnp.bfloat16) + acc[:, :1, :, 0, :] * 1e-3
+
+                def layer(inner, li):
+                    c, a = inner
+                    idx = pos[:, None] + jnp.arange(1)[None, :]
+                    if quant:
+                        sc = jnp.max(jnp.abs(kf.astype(jnp.float32)), -1) / 127.0
+                        kq = jnp.clip(jnp.round(kf.astype(jnp.float32) / sc[..., None]), -127, 127).astype(jnp.int8)
+                        c = dict(c)
+                        c["k"] = c["k"].at[li, rows[:, None], idx].set(
+                            kq, indices_are_sorted=True, unique_indices=True)
+                        c["v"] = c["v"].at[li, rows[:, None], idx].set(
+                            kq, indices_are_sorted=True, unique_indices=True)
+                        c["ks"] = c["ks"].at[li, rows[:, None], idx].set(
+                            sc, indices_are_sorted=True, unique_indices=True)
+                        c["vs"] = c["vs"].at[li, rows[:, None], idx].set(
+                            sc, indices_are_sorted=True, unique_indices=True)
+                        out = factored(
+                            a,
+                            jax.lax.dynamic_index_in_dim(c["k"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["ks"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["v"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["vs"], li, 0, False))
+                    else:
+                        c = dict(c)
+                        c["k"] = c["k"].at[li, rows[:, None], idx].set(
+                            kf.astype(jnp.bfloat16), indices_are_sorted=True,
+                            unique_indices=True)
+                        c["v"] = c["v"].at[li, rows[:, None], idx].set(
+                            kf.astype(jnp.bfloat16), indices_are_sorted=True,
+                            unique_indices=True)
+                        ck = jax.lax.dynamic_index_in_dim(c["k"], li, 0, False)
+                        cv = jax.lax.dynamic_index_in_dim(c["v"], li, 0, False)
+                        out = attend(a, ck, cv)
+                    return (c, a + out * 1e-3), ()
+
+                (c, acc), _ = jax.lax.scan(layer, (c, acc), jnp.arange(L2))
+                return (c, acc), ()
+
+            (cache, accf), _ = jax.lax.scan(step, (cache, q), None, length=CHUNK)
+            return cache, accf
+
+        def one(state):
+            c, qq = state
+            return f(c, qq)
+
+        dt, _ = slope_time(one, (cache, qflat), k1=2, k2=6)
+        print(f"attn {name:14s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+
+    carry_probe("bf16-carry", {"k": jnp.copy(kbf), "v": jnp.copy(vbf)}, False)
+    carry_probe("int8-carry", {"k": jnp.copy(ki), "v": jnp.copy(vi),
+                               "ks": jnp.copy(ks), "vs": jnp.copy(vs)}, True)
+
+    # 7/8) split: attend over the PRE-write cache (mask < pos) + fresh-token
+    # correction; scatter-write carries no read-after-write dependency.
+    def split_probe(name, cache, quant):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def f(cache, q):
+            def step(carry, _):
+                c, acc = carry
+                kf = jax.random.normal(jax.random.key(9), (B2, 1, Hkv, Dh),
+                                       jnp.bfloat16) + acc[:, :1, :, 0, :] * 1e-3
+                mask_lt = jnp.arange(T)[None, None, :] < pos[:, None, None]
+
+                def layer(inner, li):
+                    c, a = inner
+                    # --- read OLD cache (pre-write) ---
+                    if quant:
+                        out = factored_masked(
+                            a,
+                            jax.lax.dynamic_index_in_dim(c["k"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["ks"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["v"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["vs"], li, 0, False),
+                            kf, mask_lt)
+                    else:
+                        out = attend_fresh(
+                            a,
+                            jax.lax.dynamic_index_in_dim(c["k"], li, 0, False),
+                            jax.lax.dynamic_index_in_dim(c["v"], li, 0, False),
+                            kf, mask_lt)
+                    # --- scatter write (independent of the read) ---
+                    idx = pos[:, None] + jnp.arange(1)[None, :]
+                    c = dict(c)
+                    if quant:
+                        sc = jnp.max(jnp.abs(kf.astype(jnp.float32)), -1) / 127.0
+                        kq = jnp.clip(jnp.round(kf.astype(jnp.float32) / sc[..., None]), -127, 127).astype(jnp.int8)
+                        c["k"] = c["k"].at[li, rows[:, None], idx].set(
+                            kq, indices_are_sorted=True, unique_indices=True)
+                        c["v"] = c["v"].at[li, rows[:, None], idx].set(
+                            kq, indices_are_sorted=True, unique_indices=True)
+                        c["ks"] = c["ks"].at[li, rows[:, None], idx].set(
+                            sc, indices_are_sorted=True, unique_indices=True)
+                        c["vs"] = c["vs"].at[li, rows[:, None], idx].set(
+                            sc, indices_are_sorted=True, unique_indices=True)
+                    else:
+                        c["k"] = c["k"].at[li, rows[:, None], idx].set(
+                            kf.astype(jnp.bfloat16), indices_are_sorted=True,
+                            unique_indices=True)
+                        c["v"] = c["v"].at[li, rows[:, None], idx].set(
+                            kf.astype(jnp.bfloat16), indices_are_sorted=True,
+                            unique_indices=True)
+                    return (c, a + out * 1e-3), ()
+
+                (c, acc), _ = jax.lax.scan(layer, (c, acc), jnp.arange(L2))
+                return (c, acc), ()
+
+            (cache, accf), _ = jax.lax.scan(step, (cache, q), None, length=CHUNK)
+            return cache, accf
+
+        def attend_fresh(qx, ck, cv, kf, mask_lt):
+            scores = jnp.einsum("bskgd,btkd->bkgst", qx, ck,
+                                preferred_element_type=jnp.float32) / Dh**0.5
+            s_fresh = jnp.einsum("bskgd,bukd->bkgsu", qx, kf,
+                                 preferred_element_type=jnp.float32)[..., 0] / Dh**0.5
+            scores = jnp.where(mask_lt[:, None, None, :, :], scores, -1e30)
+            at_pos = jnp.arange(T)[None, None, None, None, :] == pos[:, None, None, None, None]
+            scores = jnp.where(at_pos, s_fresh[..., None], scores)
+            w = jax.nn.softmax(scores, axis=-1)
+            w_pos = jnp.take_along_axis(
+                w, pos[:, None, None, None, None] * jnp.ones(w.shape[:-1], jnp.int32)[..., None], axis=-1)[..., 0]
+            w_cache = jnp.where(at_pos, 0.0, w).astype(qx.dtype)
+            out = jnp.einsum("bkgst,btkd->bskgd", w_cache, cv)
+            out = out + jnp.einsum("bkgs,bukd->bskgd", w_pos.astype(qx.dtype), kf)[..., :, :]
+            return out
+
+        def factored_masked(qx, ck, cs, cv, vs_, kf, mask_lt):
+            scores = jnp.einsum("bskgd,btkd->bkgst", qx, ck.astype(qx.dtype),
+                                preferred_element_type=jnp.float32) / Dh**0.5
+            scores = scores * cs.transpose(0, 2, 1)[:, :, None, None, :]
+            s_fresh = jnp.einsum("bskgd,bukd->bkgsu", qx, kf,
+                                 preferred_element_type=jnp.float32)[..., 0] / Dh**0.5
+            scores = jnp.where(mask_lt[:, None, None, :, :], scores, -1e30)
+            at_pos = jnp.arange(T)[None, None, None, None, :] == pos[:, None, None, None, None]
+            scores = jnp.where(at_pos, s_fresh[..., None], scores)
+            w = jax.nn.softmax(scores, axis=-1)
+            w_pos = jnp.take_along_axis(
+                w, pos[:, None, None, None, None] * jnp.ones(w.shape[:-1], jnp.int32)[..., None], axis=-1)[..., 0]
+            w_cache = jnp.where(at_pos, 0.0, w)
+            wv = (w_cache * vs_.transpose(0, 2, 1)[:, :, None, None, :]).astype(qx.dtype)
+            out = jnp.einsum("bkgst,btkd->bskgd", wv, cv.astype(qx.dtype))
+            out = out + jnp.einsum("bkgs,bukd->bskgd", w_pos.astype(qx.dtype), kf)
+            return out
+
+        def one(state):
+            c, qq = state
+            return f(c, qq)
+
+        dt, _ = slope_time(one, (cache, qflat), k1=2, k2=6)
+        print(f"attn {name:14s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+
+    split_probe("bf16-split", {"k": jnp.copy(kbf), "v": jnp.copy(vbf)}, False)
+    split_probe("int8-split", {"k": jnp.copy(ki), "v": jnp.copy(vi),
+                               "ks": jnp.copy(ks), "vs": jnp.copy(vs)}, True)
+
+
+if __name__ == "__main__":
+    main()

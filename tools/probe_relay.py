@@ -1,0 +1,65 @@
+"""Characterize the axon relay: fixed round-trip of device_get, whether
+block_until_ready actually waits, and chained-exec timing methodology."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    x = jnp.ones((8,), jnp.float32)
+    _ = jax.device_get(x)
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        _ = jax.device_get(x)
+    rt = (time.perf_counter() - t0) / n
+    print(f"device_get tiny round-trip: {rt*1000:.2f} ms", flush=True)
+
+    y = jnp.ones((1 << 22,), jnp.float32)  # 16MB
+    _ = jax.device_get(y)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        _ = jax.device_get(y)
+    dt = (time.perf_counter() - t0) / 3
+    print(f"device_get 16MB: {dt*1000:.1f} ms -> {16/1000/dt:.1f} MB/ms", flush=True)
+
+    # block_until_ready: does it wait? Time a big reduction with it.
+    big = jnp.ones((1 << 29,), jnp.bfloat16)  # 1GiB
+
+    @jax.jit
+    def red(a):
+        return a.astype(jnp.float32).sum()
+
+    r = red(big)
+    _ = jax.device_get(r)
+    t0 = time.perf_counter()
+    r = red(big)
+    r.block_until_ready()
+    t1 = time.perf_counter()
+    _ = jax.device_get(r)
+    t2 = time.perf_counter()
+    print(f"red(1GiB): block_until_ready={1000*(t1-t0):.2f} ms, "
+          f"then get={1000*(t2-t1):.2f} ms", flush=True)
+
+    # chained execs, one sync: 8 reductions then one get
+    t0 = time.perf_counter()
+    acc = big
+    outs = [red(acc) for _ in range(8)]
+    _ = jax.device_get(outs[-1])
+    t1 = time.perf_counter()
+    print(f"8x red(1GiB)+1 get: {1000*(t1-t0):.2f} ms "
+          f"-> per red {1000*(t1-t0)/8:.2f} ms", flush=True)
+    # NOTE outs are independent -> device may run them; per-red time
+    # approximates exec time if queue depth works.
+    t0 = time.perf_counter()
+    outs = [red(big) for _ in range(32)]
+    _ = jax.device_get(outs[-1])
+    t1 = time.perf_counter()
+    print(f"32x red(1GiB)+1 get: per red {1000*(t1-t0)/32:.2f} ms "
+          f"-> {1024*32/(t1-t0)/1000:.0f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

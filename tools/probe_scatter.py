@@ -1,0 +1,98 @@
+"""Bisect what makes the real decode's cache-xs scan materialize slices:
+A) plain xs-read attention scan (baseline, known fast)
+B) + final batched scatter into the same cache (no donation)
+C) + donation of the cache
+D) + weights-in-xs MLP work interleaved
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tools.timing import slope_time
+
+B, T, Hkv, G, Dh, L = 160, 257, 8, 2, 128, 16
+D_MODEL, F = 2048, 5632
+CHUNK = 32
+
+
+def attend(qx, ck, cv, mask):
+    scores = jnp.einsum("bskgd,bktd->bkgst", qx, ck,
+                        preferred_element_type=jnp.float32) / Dh**0.5
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(qx.dtype)
+    return jnp.einsum("bkgst,bktd->bskgd", w, cv)
+
+
+def mk_cache(key):
+    kbf = jax.random.normal(key, (L, B, Hkv, T, Dh), jnp.bfloat16)
+    return {"k": kbf, "v": kbf + 1}
+
+
+def run(name, with_scatter, donate, with_mlp):
+    pos = jnp.full((B,), 128, jnp.int32)
+    rows = jnp.arange(B)
+    mask = (jnp.arange(T)[None, None, :] < 128)
+
+    if with_mlp:
+        wk = jax.random.split(jax.random.key(7), 3)
+        weights = {
+            "g": jax.random.normal(wk[0], (L, D_MODEL, F), jnp.bfloat16) * 0.02,
+            "u": jax.random.normal(wk[1], (L, D_MODEL, F), jnp.bfloat16) * 0.02,
+            "d": jax.random.normal(wk[2], (L, F, D_MODEL), jnp.bfloat16) * 0.02,
+        }
+    else:
+        weights = {}
+
+    donate_args = (0,) if donate else ()
+
+    @functools.partial(jax.jit, donate_argnums=donate_args)
+    def f(cache, q, h):
+        def step(carry, _):
+            c, q, h = carry
+
+            def layer(inner, xs):
+                acc, hh = inner
+                cl, w = xs
+                out = attend(acc, cl["k"], cl["v"], mask)
+                acc = acc + out * 1e-3
+                if with_mlp:
+                    hid = jax.nn.silu(jnp.einsum("bd,df->bf", hh, w["g"])) \
+                        * jnp.einsum("bd,df->bf", hh, w["u"])
+                    hh = hh + jnp.einsum("bf,fd->bd", hid, w["d"])
+                fresh = (acc[:, 0, :, 0, :] * 1e-3).astype(jnp.bfloat16)
+                return (acc, hh), fresh
+
+            (q, h), fresh = jax.lax.scan(layer, (q, h), (c, weights))
+            if with_scatter:
+                # fresh: [L, B, Hkv, Dh] -> [B, L, Hkv, Dh] at [:, rows, :, pos]
+                upd = jnp.swapaxes(fresh, 0, 1)
+                c = dict(c)
+                c["k"] = c["k"].at[:, rows, :, pos].set(
+                    upd, unique_indices=True)
+                c["v"] = c["v"].at[:, rows, :, pos].set(
+                    upd, unique_indices=True)
+            return (c, q, h), ()
+
+        (cache, q, h), _ = jax.lax.scan(step, (cache, q, h), None,
+                                        length=CHUNK)
+        return cache, q, h
+
+    cache = mk_cache(jax.random.key(1))
+    q = jax.random.normal(jax.random.key(2), (B, 1, Hkv, G, Dh), jnp.bfloat16)
+    h = jnp.ones((B, D_MODEL), jnp.bfloat16)
+
+    def one(state):
+        c, qq, hh = state
+        return f(c, qq, hh)
+
+    dt, _ = slope_time(one, (cache, q, h), k1=2, k2=6)
+    print(f"{name:24s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    run("A xs-read only", False, False, False)
+    run("B +scatter", True, False, False)
+    run("C +scatter+donate", True, True, False)
+    run("D +scatter+donate+mlp", True, True, True)
