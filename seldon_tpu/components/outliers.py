@@ -62,6 +62,12 @@ class _TagMetricsMixin:
              "value": float(np.max(s))},
             {"type": "GAUGE", "key": "outlier_score_mean",
              "value": float(np.mean(s))},
+            # Exported so dashboards can draw the decision line next to
+            # the live score (ref per-detector Grafana configs).
+            {"type": "GAUGE", "key": "outlier_threshold",
+             "value": float(self.threshold)},
+            {"type": "COUNTER", "key": "outliers_total",
+             "value": float(np.sum(s > self.threshold))},
         ]
 
 

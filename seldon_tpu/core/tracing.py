@@ -139,14 +139,19 @@ class Tracer:
 
     # -- span lifecycle ------------------------------------------------------
 
-    @contextlib.contextmanager
     def span(self, name: str, parent: Optional[SpanContext] = None,
              attributes: Optional[Dict[str, Any]] = None):
         """Context manager: opens a child of `parent`, else of the current
-        contextvar span, else a new root."""
+        contextvar span, else a new root. Disabled tracers return one
+        shared nullcontext — a generator contextmanager per request is
+        measurable overhead on the engine hot path."""
         if not self.enabled:
-            yield _NOOP_SPAN
-            return
+            return _NOOP_CM
+        return self._span_cm(name, parent, attributes)
+
+    @contextlib.contextmanager
+    def _span_cm(self, name: str, parent: Optional[SpanContext],
+                 attributes: Optional[Dict[str, Any]]):
         if parent is None:
             cur = _current_span.get()
             if cur is not None:
@@ -221,6 +226,9 @@ class _NoopSpan:
 
 
 _NOOP_SPAN = _NoopSpan()
+# nullcontext is stateless -> one shared instance serves every disabled
+# span() call.
+_NOOP_CM = contextlib.nullcontext(_NOOP_SPAN)
 _NOOP_TRACER = Tracer("noop", enabled=False)
 
 

@@ -681,25 +681,83 @@ def build_istio_manifests(sdep: T.SeldonDeployment) -> List[Dict]:
     return [vs] + drs
 
 
+def _parse_header_annotation(value: str) -> Dict[str, str]:
+    """'key1:val1:key2:val2' -> dict (reference ambassador.go:100-117)."""
+    parts = value.split(":")
+    out: Dict[str, str] = {}
+    for i in range(0, len(parts) - 1, 2):
+        out[parts[i].strip()] = parts[i + 1].strip()
+    return out
+
+
 def ambassador_annotations(sdep: T.SeldonDeployment) -> str:
-    """Ambassador v1 Mapping YAML block (reference ambassador.go:50-263)."""
+    """Ambassador v1 Mapping YAML block (reference ambassador.go:50-263).
+
+    Behavior knobs via deployment annotations:
+      seldon.io/ambassador-config        — verbatim override of the config
+      seldon.io/ambassador-shadow        — non-empty: predictors become
+        SHADOW mappings (traffic mirrored to them, responses discarded —
+        canary testing against production load, ambassador.go:119-133)
+      seldon.io/ambassador-header        — 'k:v[:k2:v2]' exact-match header
+        routing; the mapping only serves requests carrying the headers
+      seldon.io/ambassador-regex-header  — same, regex match
+      seldon.io/ambassador-service-name  — external path name override
+      seldon.io/ambassador-id            — restrict to one ambassador
+        instance (ambassador_id)
+    """
+    custom = sdep.annotations.get(T.ANNOTATION_AMBASSADOR_CUSTOM, "")
+    if custom:
+        return custom
+    shadow = sdep.annotations.get(T.ANNOTATION_AMBASSADOR_SHADOW, "")
+    svc_external = sdep.annotations.get(
+        T.ANNOTATION_AMBASSADOR_SERVICE, sdep.name
+    )
+    header = _parse_header_annotation(
+        sdep.annotations.get(T.ANNOTATION_AMBASSADOR_HEADER, "")
+    )
+    regex_header = _parse_header_annotation(
+        sdep.annotations.get(T.ANNOTATION_AMBASSADOR_REGEX_HEADER, "")
+    )
+    instance_id = sdep.annotations.get(T.ANNOTATION_AMBASSADOR_ID, "")
+
+    def header_yaml(tag: str, headers: Dict[str, str]) -> str:
+        if not headers:
+            return ""
+        lines = "".join(f"  {k}: {v}\n" for k, v in headers.items())
+        return f"{tag}:\n{lines}"
+
+    extras = ""
+    if shadow:
+        extras += "shadow: true\n"
+    extras += header_yaml("headers", header)
+    extras += header_yaml("regex_headers", regex_header)
+    if instance_id:
+        extras += f"ambassador_id: {instance_id}\n"
+
     blocks = []
     for pred in sdep.predictors:
         svc = T.predictor_service_name(sdep, pred)
         timeout = sdep.annotations.get(T.ANNOTATION_REST_READ_TIMEOUT, "3000")
+        grpc_timeout = sdep.annotations.get(
+            T.ANNOTATION_GRPC_READ_TIMEOUT, "3000"
+        )
+        weight = pred.spec.traffic if len(sdep.predictors) > 1 else 100
         blocks.append(
             "---\n"
             "apiVersion: ambassador/v1\n"
             "kind: Mapping\n"
             f"name: seldon_{sdep.namespace}_{sdep.name}_{pred.spec.name}_rest\n"
-            f"prefix: /seldon/{sdep.namespace}/{sdep.name}/\n"
+            f"prefix: /seldon/{sdep.namespace}/{svc_external}/\n"
             f"service: {svc}.{sdep.namespace}:{T.ENGINE_HTTP_PORT}\n"
             f"timeout_ms: {timeout}\n"
-            f"weight: {pred.spec.traffic}\n"
+            f"weight: {weight}\n"
             "retry_policy:\n"
             "  retry_on: connect-failure\n"
             "  num_retries: 3\n"
+            + extras
         )
+        grpc_headers = {"seldon": svc_external, "namespace": sdep.namespace,
+                        **header}
         blocks.append(
             "---\n"
             "apiVersion: ambassador/v1\n"
@@ -707,9 +765,13 @@ def ambassador_annotations(sdep: T.SeldonDeployment) -> str:
             f"name: seldon_{sdep.namespace}_{sdep.name}_{pred.spec.name}_grpc\n"
             "grpc: true\n"
             f"prefix: /seldon.protos.Seldon/\n"
-            f"headers:\n  seldon: {sdep.name}\n  namespace: {sdep.namespace}\n"
-            f"service: {svc}.{sdep.namespace}:{T.ENGINE_GRPC_PORT}\n"
-            f"weight: {pred.spec.traffic}\n"
+            + header_yaml("headers", grpc_headers)
+            + f"service: {svc}.{sdep.namespace}:{T.ENGINE_GRPC_PORT}\n"
+            f"timeout_ms: {grpc_timeout}\n"
+            f"weight: {weight}\n"
+            + ("shadow: true\n" if shadow else "")
+            + header_yaml("regex_headers", regex_header)
+            + (f"ambassador_id: {instance_id}\n" if instance_id else "")
         )
     return "".join(blocks)
 

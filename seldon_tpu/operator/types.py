@@ -25,7 +25,15 @@ ENV_ENGINE_PREDICTOR = "ENGINE_PREDICTOR"
 ANNOTATION_SEPARATE_ENGINE = "seldon.io/engine-separate-pod"
 ANNOTATION_HEADLESS_SVC = "seldon.io/headless-svc"
 ANNOTATION_REST_READ_TIMEOUT = "seldon.io/rest-read-timeout"
+ANNOTATION_GRPC_READ_TIMEOUT = "seldon.io/grpc-read-timeout"
 ANNOTATION_GRPC_MAX_MSG = "seldon.io/grpc-max-message-size"
+# Ambassador behavior knobs (reference ambassador.go:13-18).
+ANNOTATION_AMBASSADOR_CUSTOM = "seldon.io/ambassador-config"
+ANNOTATION_AMBASSADOR_SHADOW = "seldon.io/ambassador-shadow"
+ANNOTATION_AMBASSADOR_SERVICE = "seldon.io/ambassador-service-name"
+ANNOTATION_AMBASSADOR_HEADER = "seldon.io/ambassador-header"
+ANNOTATION_AMBASSADOR_REGEX_HEADER = "seldon.io/ambassador-regex-header"
+ANNOTATION_AMBASSADOR_ID = "seldon.io/ambassador-id"
 # TPU-native additions.
 ANNOTATION_TPU_TOPOLOGY = "seldon.io/tpu-topology"
 ANNOTATION_TPU_ACCELERATOR = "seldon.io/tpu-accelerator"

@@ -200,6 +200,211 @@ def _decode_pallas(q, k, v, pos, k_scale, v_scale, block_t, interpret):
     )(*args).reshape(B, H, Dh)
 
 
+def _cached_kernel(li_ref, pos_ref, bmax_ref, q_ref, kf_ref, vf_ref, k_ref,
+                   v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, block_b, block_t, scale, quantized):
+    """Layer-indexed decode attention over the PRE-write cache with the
+    fresh token folded into the flash-init:
+
+        m0 = s_fresh = (q . k_fresh) * scale,  l0 = 1,  acc0 = v_fresh
+
+    which IS the softmax state after processing exactly one (the fresh)
+    column — the cache tiles then stream through the standard online-
+    softmax update with a STRICT t < pos bound (slot pos is stale; the
+    engine scatters this step's k/v after the layer scan).
+
+    Each grid cell covers BLOCK_B batch rows x one T tile: per-cell fixed
+    cost measured ~4 us on v5e, so one-row cells (B x n_t grid) burn more
+    time in overhead than in the 84 MB cache read. Work is kept in the
+    flat [block_b*Hkv, ...] form and per-row bounds apply as UNROLLED
+    scalar masks (Mosaic cannot broadcast an SMEM-built vector over major
+    dims — vector<16> -> vector<16x1x1x1> shape casts are rejected)."""
+    from jax.experimental import pallas as pl
+
+    bi = pl.program_id(0)
+    tj = pl.program_id(1)
+    bb = q_ref.shape[0]
+    Hkv, G, Dh = q_ref.shape[1:]
+    # Per-row bounds: scalar loads (SMEM serves scalars only).
+    bounds = [pos_ref[bi * block_b + i] for i in range(block_b)]
+    block_max = bmax_ref[bi]
+
+    @pl.when(tj == 0)
+    def _init():
+        qf = q_ref[...].astype(jnp.float32).reshape(bb * Hkv, G, Dh)
+        kf = kf_ref[...].astype(jnp.float32).reshape(bb * Hkv, 1, Dh)
+        vf = vf_ref[...].astype(jnp.float32).reshape(bb * Hkv, 1, Dh)
+        s_f = jnp.sum(qf * kf, axis=-1) * scale  # [bb*Hkv, G]
+        m_scr[:] = s_f
+        l_scr[:] = jnp.ones_like(l_scr)
+        # acc [bb*Hkv, Dh, G] = v_fresh per (row, d), replicated over G.
+        acc_scr[:] = jnp.broadcast_to(
+            vf.transpose(0, 2, 1), acc_scr.shape
+        ).astype(jnp.float32)
+
+    def _mask_rows(x, t0, fill):
+        """x [bb*Hkv, block_t, last]: per-row scalar bound, unrolled."""
+        ti = t0 + jax.lax.broadcasted_iota(
+            jnp.int32, (Hkv,) + x.shape[1:], 1
+        )
+        rows = [
+            jnp.where(ti < bounds[i], x[i * Hkv:(i + 1) * Hkv], fill)
+            for i in range(block_b)
+        ]
+        return jnp.concatenate(rows, axis=0)
+
+    # Skip tiles wholly past every row's bound in this block.
+    @pl.when(tj * block_t < block_max)
+    def _accumulate():
+        q = q_ref[...].astype(jnp.float32).reshape(bb * Hkv, G, Dh)
+        k = k_ref[0].astype(jnp.float32)  # [bb, Hkv, block_t, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0][..., None].astype(jnp.float32)
+            v = v * vs_ref[0][..., None].astype(jnp.float32)
+        k = k.reshape(bb * Hkv, block_t, Dh)
+        v = v.reshape(bb * Hkv, block_t, Dh)
+        st = jax.lax.dot_general(
+            k, q, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bb*Hkv, block_t, G]
+        st = _mask_rows(st, tj * block_t, NEG_INF)
+        # Zero v's masked rows: tail tiles read past the window (pallas
+        # pads with garbage, possibly NaN) and 0 * NaN would poison the
+        # value matmul even though p is 0 there.
+        v = _mask_rows(v, tj * block_t, 0.0)
+
+        m_prev = m_scr[:].reshape(bb * Hkv, 1, G)
+        m_new = jnp.maximum(m_prev, jnp.max(st, axis=1, keepdims=True))
+        p = jnp.exp(st - m_new)  # [bb*Hkv, block_t, G]
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha[:, 0] * l_scr[:] + jnp.sum(p, axis=1)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            v, p, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new[:, 0]
+
+    @pl.when(tj == pl.num_programs(1) - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)[:, None, :]  # [bb*Hkv, 1, G]
+        out = acc_scr[:] / l  # [bb*Hkv, Dh, G]
+        o_ref[...] = (
+            out.transpose(0, 2, 1).reshape(bb, Hkv, G, Dh).astype(o_ref.dtype)
+        )
+
+
+def _cached_kernel_bf16(li_ref, pos_ref, bmax_ref, q_ref, kf_ref, vf_ref,
+                        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                        *, block_b, block_t, scale):
+    _cached_kernel(li_ref, pos_ref, bmax_ref, q_ref, kf_ref, vf_ref, k_ref,
+                   v_ref, None, None, o_ref, m_scr, l_scr, acc_scr,
+                   block_b=block_b, block_t=block_t, scale=scale,
+                   quantized=False)
+
+
+def decode_attention_cached(
+    q: jnp.ndarray,  # [B, H, Dh] this layer's rope'd queries
+    k_fresh: jnp.ndarray,  # [B, Hkv, 1, Dh] exact bf16 fresh k (rope'd)
+    v_fresh: jnp.ndarray,  # [B, Hkv, 1, Dh]
+    cache_k: jnp.ndarray,  # [L, B, Hkv, T, Dh] FULL stacked cache
+    cache_v: jnp.ndarray,
+    li: jnp.ndarray,  # [] int32 layer index (traced)
+    pos: jnp.ndarray,  # [B] int32: attend to t < pos[b] plus the fresh col
+    k_scale: jnp.ndarray = None,  # [L, B, Hkv, T] when cache is int8
+    v_scale: jnp.ndarray = None,
+    block_b: int = 8,
+    block_t: int = DEFAULT_BLOCK_T,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pre-write decode attention with the cache consumed IN PLACE.
+
+    The whole stacked [L, ...] cache is the pallas operand and the layer
+    index rides scalar prefetch into the BlockSpec index maps, so calling
+    this inside the layer scan streams exactly layer li's tiles HBM->VMEM
+    — no per-layer dynamic-slice materialization (the cost that killed
+    both the XLA post-write path and the earlier per-layer kernel).
+    Returns [B, H, Dh]. B must be a multiple of block_b (the engine's
+    slot counts are; block_b is shrunk to B when B is smaller)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Dh = q.shape
+    L, _, Hkv, T, _ = cache_k.shape
+    G = H // Hkv
+    quantized = k_scale is not None
+    block_t = min(block_t, T)
+    n_t = -(-T // block_t)
+    while B % block_b:
+        block_b //= 2
+    qg = q.reshape(B, Hkv, G, Dh)
+
+    grid = (B // block_b, n_t)
+
+    # Tiles at or past every block row's bound clamp to the block's last
+    # live tile (DMA pruning); bound == 0 (no past) still maps a tile,
+    # but compute is skipped by the pl.when gate. The per-block max bound
+    # is precomputed host-side and scalar-prefetched (index maps run on
+    # the scalar core — no vector reductions there).
+    def kv_idx(b, t, li_ref, pos_ref, bmax_ref):
+        t_live = jnp.minimum(
+            t, jnp.maximum(bmax_ref[b] - 1, 0) // block_t
+        )
+        return (li_ref[0], b, 0, t_live, 0)
+
+    def scale_idx(b, t, li_ref, pos_ref, bmax_ref):
+        t_live = jnp.minimum(
+            t, jnp.maximum(bmax_ref[b] - 1, 0) // block_t
+        )
+        return (li_ref[0], b, 0, t_live)
+
+    def row_idx(b, t, li_ref, pos_ref, bmax_ref):
+        return (b, 0, 0, 0)
+
+    q_spec = pl.BlockSpec((block_b, Hkv, G, Dh), row_idx)
+    fresh_spec = pl.BlockSpec((block_b, Hkv, 1, Dh), row_idx)
+    kv_spec = pl.BlockSpec((1, block_b, Hkv, block_t, Dh), kv_idx)
+    li_arr = jnp.reshape(li, (1,)).astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    block_max = jnp.max(pos32.reshape(B // block_b, block_b), axis=1)
+    if quantized:
+        kernel = functools.partial(
+            _cached_kernel, block_b=block_b, block_t=block_t,
+            scale=Dh**-0.5, quantized=True,
+        )
+        scale_spec = pl.BlockSpec((1, block_b, Hkv, block_t), scale_idx)
+        in_specs = [q_spec, fresh_spec, fresh_spec, kv_spec, kv_spec,
+                    scale_spec, scale_spec]
+        args = (li_arr, pos32, block_max, qg, k_fresh, v_fresh,
+                cache_k, cache_v, k_scale, v_scale)
+    else:
+        kernel = functools.partial(
+            _cached_kernel_bf16, block_b=block_b, block_t=block_t,
+            scale=Dh**-0.5,
+        )
+        in_specs = [q_spec, fresh_spec, fresh_spec, kv_spec, kv_spec]
+        args = (li_arr, pos32, block_max, qg, k_fresh, v_fresh,
+                cache_k, cache_v)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_b, Hkv, G, Dh), row_idx),
+        scratch_shapes=[
+            pltpu.VMEM((block_b * Hkv, G), jnp.float32),
+            pltpu.VMEM((block_b * Hkv, G), jnp.float32),
+            pltpu.VMEM((block_b * Hkv, Dh, G), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(*args).reshape(B, H, Dh)
+
+
 def _on_tpu() -> bool:
     try:
         platform = jax.devices()[0].platform

@@ -151,7 +151,9 @@ class EngineServer:
         async def ready(request: web.Request) -> web.Response:
             if self.paused:
                 return web.Response(status=503, text="paused")
-            if await self.ready_checker.ready():
+            is_ready = await self.ready_checker.ready()
+            self.metrics.set_graph_ready(is_ready)  # seldon_graph_ready gauge
+            if is_ready:
                 return web.Response(text="ready")
             return web.Response(status=503, text="graph not ready")
 

@@ -54,6 +54,7 @@ class ServerMetrics:
         # name -> (metric type, tag key tuple, collector)
         self._custom: dict = {}
         self._dropped: set = set()
+        self._observe_cache: dict = {}
         self._reward = Counter(
             "seldon_api_model_feedback_reward_total",
             "Accumulated feedback reward",
@@ -72,12 +73,30 @@ class ServerMetrics:
             ["unit"],
             registry=self._registry,
         )
+        self._graph_ready = Gauge(
+            "seldon_graph_ready",
+            "1 when the predictor graph passes its readiness probe",
+            registry=self._registry,
+        )
+
+    def set_graph_ready(self, ready: bool) -> None:
+        if _HAVE_PROM:
+            self._graph_ready.set(1.0 if ready else 0.0)
 
     def observe(self, method: str, transport: str, seconds: float, response) -> None:
         if not _HAVE_PROM:  # pragma: no cover
             return
-        self._requests.labels(method, transport).inc()
-        self._latency.labels(method, transport).observe(seconds)
+        children = self._observe_cache.get((method, transport))
+        if children is None:
+            # prometheus_client's labels() re-validates + locks per call;
+            # the (method, transport) space is tiny, cache the children.
+            children = (
+                self._requests.labels(method, transport),
+                self._latency.labels(method, transport),
+            )
+            self._observe_cache[(method, transport)] = children
+        children[0].inc()
+        children[1].observe(seconds)
         if response is not None and hasattr(response, "meta"):
             try:
                 self.record_custom(response.meta.metrics)

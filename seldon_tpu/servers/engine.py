@@ -65,6 +65,12 @@ class _Request:
     first_token_at: Optional[float] = None
     n_generated: int = 0
     slot: int = -1
+    # Host-side upper bound of tokens produced by dispatched-but-unread
+    # chunks (admission token + decode_chunk per dispatched chunk) —
+    # drives optimistic slot recycling; the device's `remaining` counter
+    # guarantees the row really is frozen once the budget is spent.
+    expected: int = 0
+    finished: bool = False
 
 
 class EngineStats:
@@ -134,11 +140,28 @@ class InferenceEngine:
             functools.partial(self._admit_impl, cfg=self.cfg),
             donate_argnums=(1,),
         )
+        # Pallas decode-attention kernel (layer-indexed, pre-write cache,
+        # in-kernel int8 dequant — ops/decode_attention.py). Single-chip
+        # TPU only: pallas doesn't auto-partition under GSPMD.
+        # SELDON_TPU_DECODE_KERNEL=0 reverts to the XLA einsum path.
+        import os as _os
+
+        from seldon_tpu.ops.decode_attention import _on_tpu
+
+        n_mesh_devices = (
+            1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+        )
+        self._decode_kernel = (
+            _os.environ.get("SELDON_TPU_DECODE_KERNEL", "0") == "1"
+            and n_mesh_devices == 1
+            and _on_tpu()
+        )
         self._jit_chunk = jax.jit(
             functools.partial(
                 self._chunk_impl,
                 cfg=self.cfg,
                 n_steps=max(1, self.ecfg.decode_chunk),
+                decode_kernel=self._decode_kernel,
             ),
             donate_argnums=(1,),
         )
@@ -211,7 +234,7 @@ class InferenceEngine:
         return new_state, first, first_done
 
     @staticmethod
-    def _chunk_impl(params, state, *, cfg, n_steps):
+    def _chunk_impl(params, state, *, cfg, n_steps, decode_kernel=False):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
         value-level: finished rows stop advancing and emit invalid tokens
@@ -222,6 +245,7 @@ class InferenceEngine:
             run = carry["active"]
             logits, cache = transformer.decode_step(
                 params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
+                decode_kernel=decode_kernel,
             )
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
@@ -386,14 +410,11 @@ class InferenceEngine:
                 )
                 for req in group:
                     slot = req.slot
-                    if slot >= 0:
-                        if self._slots[slot] is req:
-                            self._slots[slot] = None
-                            self._active_host[slot] = False
-                        if slot not in self._free:
-                            self._free.append(slot)
+                    if slot >= 0 and self._slots[slot] is not req \
+                            and slot not in self._free:
+                        self._free.append(slot)  # popped but never registered
                     req.out.put({"error": str(e)})
-                    req.out.put(None)
+                    self._complete(req)
         return admits
 
     def _dispatch_admit_group(
@@ -411,6 +432,7 @@ class InferenceEngine:
             Gp *= 2
         for req in group:
             req.slot = self._free.pop()
+            req.expected = 1  # the admission samples the first token
         toks = np.full((Gp, Sb), self.cfg.pad_token_id, np.int32)
         plens = np.empty((Gp,), np.int32)
         seeds = np.empty((Gp,), np.uint32)
@@ -465,22 +487,28 @@ class InferenceEngine:
                 req.n_generated = 1
                 req.out.put({"tokens": [first_tok], "ttft_ms": ttft_ms})
                 if bool(done_h[i]):
-                    self._finish(slot)
-                else:
+                    self._complete(req)
+                elif self._slots[slot] is req:
+                    # Not armed when the slot was already optimistically
+                    # recycled (budget spent within in-flight chunks).
                     self._active_host[slot] = True
             with self.stats.lock:
                 self.stats.ttft_sum += ttft_total / 1000.0
                 self.stats.ttft_count += len(group)
                 self.stats.tokens_out += len(group)
 
-    def _process_chunk(self, toks_h, valid_h, active_h) -> None:
-        """toks_h [K, B], valid_h [K, B], active_h [B] — host arrays.
-        `valid` is a True-prefix per column (rows stop and stay stopped
-        within a chunk), so the first n_valid rows are the emitted tokens."""
+    def _process_chunk(self, toks_h, valid_h, active_h, roster) -> None:
+        """toks_h [K, B], valid_h [K, B], active_h [B] — host arrays;
+        `roster` is the slot->request snapshot taken when THIS chunk was
+        dispatched (the live slot table may have moved on: optimistic
+        recycling hands freed slots to new requests before old results
+        are read). `valid` is a True-prefix per column (rows stop and
+        stay stopped within a chunk), so the first n_valid rows are the
+        emitted tokens."""
         n_valid = valid_h.sum(axis=0)
         total = 0
-        for slot, req in enumerate(self._slots):
-            if req is None or not self._active_host[slot]:
+        for slot, req in enumerate(roster):
+            if req is None or req.finished:
                 continue
             n = int(n_valid[slot])
             if n:
@@ -488,32 +516,56 @@ class InferenceEngine:
                 req.n_generated += n
                 total += n
             if not active_h[slot]:
-                self._finish(slot)
+                self._complete(req)
         if total:
             with self.stats.lock:
                 self.stats.tokens_out += total
 
-    def _finish(self, slot: int) -> None:
-        req = self._slots[slot]
-        if req is None:
+    def _complete(self, req: _Request) -> None:
+        """Finish a request (idempotent) and free its slot unless the
+        slot has already been recycled to a newer request."""
+        if req.finished:
             return
+        req.finished = True
         req.out.put(None)
-        self._slots[slot] = None
-        self._active_host[slot] = False
-        self._free.append(slot)
+        slot = req.slot
+        if 0 <= slot < len(self._slots) and self._slots[slot] is req:
+            self._slots[slot] = None
+            self._active_host[slot] = False
+            self._free.append(slot)
         with self.stats.lock:
             self.stats.completed += 1
 
-    def _fail_all(self, err: str) -> None:
-        """Fail every registered request and reset device state — called
-        when a dispatched computation errored (donated buffers are gone)."""
-        for slot, req in enumerate(self._slots):
+    def _fail_all(self, err: str, pendings=()) -> None:
+        """Fail every live request and reset device + slot state — called
+        when a dispatched computation errored (donated buffers are gone).
+        `pendings`: in-flight (admits, handles, roster) tuples — requests
+        optimistically recycled out of `_slots` live only there."""
+        live: Dict[int, _Request] = {}
+        for req in self._slots:
             if req is not None:
+                live[req.rid] = req
+        for pending in pendings:
+            if pending is None:
+                continue
+            admits, _, roster = pending
+            for group, _, _ in admits:
+                for req in group:
+                    live[req.rid] = req
+            for req in roster or []:
+                if req is not None:
+                    live[req.rid] = req
+        for req in live.values():
+            if not req.finished:
                 req.out.put({"error": err})
-                self._finish(slot)
+                self._complete(req)
+        B = self.ecfg.max_slots
+        self._slots = [None] * B
+        self._free = list(range(B))
+        self._active_host[:] = False
         self._state = self._fresh_state()
 
-    def _process_boundary(self, admits, chunk_handles) -> None:
+    def _process_boundary(self, admits, chunk_handles, roster) -> None:
         """Fetch one boundary's device results (one parallel transfer) and
         run host bookkeeping."""
         admit_data, chunk_data = jax.device_get(
@@ -524,56 +576,61 @@ class InferenceEngine:
         )
         self._process_admits(admits, admit_data)
         if chunk_data is not None:
-            self._process_chunk(*chunk_data)
+            self._process_chunk(*chunk_data, roster)
 
-    def _pipeline_safe(self, have_pending: bool) -> bool:
-        """True when every in-flight row is expected to survive the next
-        decode chunk (by length budget; EOS is unpredictable and merely
-        costs one masked chunk when mispredicted). When False the scheduler
-        syncs first so finished slots are freed and re-admitted without a
-        wasted chunk."""
-        K = max(1, self.ecfg.decode_chunk)
-        lag = K if have_pending else 0
-        for slot, req in enumerate(self._slots):
-            if req is None:
+    def _recycle_budget_spent(self, roster: List[Optional[_Request]]) -> None:
+        """Optimistic slot recycling: `expected` is an upper bound on the
+        tokens a row will have produced once every dispatched chunk
+        retires, and the device-side `remaining` counter guarantees a row
+        NEVER exceeds its budget — so a slot whose budget is provably
+        spent can take a new request immediately, without waiting for the
+        chunk's results. The next admission's cache scatter is queued
+        AFTER the chunk device-side, so ordering is exact. This removes
+        the end-of-wave stall where the scheduler used to sync (one full
+        host round trip with an idle device) before refilling slots."""
+        for slot, req in enumerate(roster):
+            if req is None or req.finished:
                 continue
-            if req.params.max_new_tokens - (req.n_generated + lag) <= K:
-                return False
-        return True
+            req.expected += max(1, self.ecfg.decode_chunk)
+            if req.expected >= req.params.max_new_tokens:
+                if self._slots[slot] is req:
+                    self._slots[slot] = None
+                    self._active_host[slot] = False
+                    self._free.append(slot)
 
     def _loop(self) -> None:
         # Software-pipelined scheduler: chunk N+1 is dispatched BEFORE
         # chunk N's results are fetched, so the host fetch (one device
         # round trip) and queue bookkeeping overlap with device compute.
         # This is safe because per-row termination is device-side: rows
-        # that finished during chunk N are already frozen (active=False in
-        # the carried state) when chunk N+1 runs — the host merely learns
-        # about it one boundary late. Near row completion the loop drops
-        # to sync mode so finishing slots are freed (and re-admitted)
-        # without paying a wasted masked chunk.
-        pending: Optional[Tuple[list, Any]] = None
+        # that finished during chunk N are already frozen (active=False
+        # in the carried state) when chunk N+1 runs — the host merely
+        # learns about it one boundary late (per-chunk rosters keep
+        # attribution exact). Length-bounded rows free their slots at
+        # DISPATCH time (_recycle_budget_spent), so the pipeline never
+        # drains at wave boundaries; EOS-finished rows free one boundary
+        # late.
+        pending: Optional[Tuple[list, Any, list]] = None
         while not self._stop.is_set():
+            admits, roster = [], None  # visible to the except path
             try:
                 admits = self._dispatch_admits()
-                if pending is not None and not self._pipeline_safe(True):
-                    self._process_boundary(*pending)
-                    pending = None
-                    # Freed slots can take waiting requests this boundary.
-                    admits.extend(self._dispatch_admits())
                 if admits or self._active_host.any():
                     # Chunk consumes the post-admission state; device-side
                     # `active` is already armed even though _active_host
                     # lags until _process_admits.
+                    roster = list(self._slots)
                     self._state, toks, valid, active_after = self._jit_chunk(
                         self.params, self._state
                     )
                     chunk_handles = (toks, valid, active_after)
+                    self._recycle_budget_spent(roster)
                 else:
                     chunk_handles = None
                 if pending is not None:
                     self._process_boundary(*pending)
                 pending = (
-                    (admits, chunk_handles)
+                    (admits, chunk_handles, roster)
                     if (admits or chunk_handles is not None)
                     else None
                 )
@@ -582,12 +639,14 @@ class InferenceEngine:
                         time.sleep(self.ecfg.idle_sleep_s)
             except Exception as e:  # fail requests, reset, keep serving
                 logger.exception("engine iteration failed")
+                # The CURRENT iteration's admits/roster may hold requests
+                # already recycled out of _slots — fail them too.
+                self._fail_all(str(e), [pending, (admits, None, roster)])
                 pending = None
-                self._fail_all(str(e))
         # Drain the in-flight boundary so stop() doesn't strand requests.
         if pending is not None:
             try:
                 self._process_boundary(*pending)
             except Exception as e:
                 logger.exception("final boundary failed")
-                self._fail_all(str(e))
+                self._fail_all(str(e), [pending])

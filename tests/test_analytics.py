@@ -1,0 +1,156 @@
+"""Analytics-stack consistency: every metric a dashboard panel or alert
+rule queries must actually be exported by the code (reference ships
+per-detector dashboards + alertmanager in seldon-core-analytics;
+VERDICT r2 found the repo's dashboards referencing phantom names)."""
+
+import glob
+import json
+import os
+import re
+
+import yaml
+
+ANALYTICS = os.path.join(os.path.dirname(__file__), "..", "deploy", "analytics")
+
+# Metric families genuinely exported by this codebase.
+EXPORTED = {
+    # runtime/metrics_server.py ServerMetrics
+    "seldon_api_executor_server_requests_total",
+    "seldon_api_executor_server_requests_seconds",  # histogram base
+    "seldon_api_model_feedback_reward_total",
+    "seldon_api_model_feedback_reward_negative_total",
+    "seldon_api_model_feedback_total",
+    "seldon_graph_ready",
+    # components/outliers.py _TagMetricsMixin.metrics()
+    "outlier_score_max",
+    "outlier_score_mean",
+    "outlier_threshold",
+    "outliers_total",
+    # servers/jaxserver.py metrics()
+    "jaxserver_mean_ttft_ms",
+    "jaxserver_tokens_out",
+    "jaxserver_completed",
+}
+# Series emitted by external exporters we integrate with (kube-state-metrics).
+EXTERNAL = {"kube_statefulset_status_replicas_ready", "kube_statefulset_replicas"}
+
+_PROM_FUNCS = {
+    "sum", "rate", "irate", "avg", "max", "min", "count", "histogram_quantile",
+    "by", "le", "deriv", "increase", "label_values", "instance", "on",
+    "group_left", "group_right", "abs", "clamp_min", "clamp_max", "vector",
+}
+
+
+def _metric_names(expr: str):
+    for name in re.findall(r"[a-zA-Z_:][a-zA-Z0-9_:]*", expr):
+        if name in _PROM_FUNCS or name.startswith("$"):
+            continue
+        if re.match(r"^[0-9.]+$", name):
+            continue
+        # label matchers appear inside {...}; strip by only taking names
+        # that look like series (contain '_' and not pure label keys).
+        yield name
+
+
+def _series_in(expr: str):
+    # Remove label-matcher blocks so label keys/values don't false-positive.
+    cleaned = re.sub(r"\{[^}]*\}", "", expr)
+    for name in _metric_names(cleaned):
+        if "_" in name:
+            yield name
+
+
+def _strip_histogram_suffix(name: str) -> str:
+    for suf in ("_bucket", "_count", "_sum"):
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def test_dashboard_exprs_reference_exported_metrics():
+    dashboards = glob.glob(os.path.join(ANALYTICS, "grafana-*.json"))
+    assert len(dashboards) >= 6, dashboards  # serving + 4 detectors + rewards
+    for path in dashboards:
+        with open(path) as f:
+            dash = json.load(f)
+        exprs = [
+            t["expr"]
+            for p in dash.get("panels", [])
+            for t in p.get("targets", [])
+        ] + [
+            v["query"]
+            for v in dash.get("templating", {}).get("list", [])
+            if v.get("type") == "query"
+        ]
+        assert exprs, f"{path} has no queries"
+        for expr in exprs:
+            e = expr.replace("label_values(", "").rstrip(")")
+            for name in _series_in(e):
+                base = _strip_histogram_suffix(name)
+                assert base in EXPORTED | EXTERNAL, (
+                    f"{os.path.basename(path)} queries {name!r} which nothing exports"
+                )
+
+
+def test_detector_dashboards_cover_every_family():
+    families = ["mahalanobis", "vae", "isolation-forest", "seq2seq-lstm"]
+    for fam in families:
+        path = os.path.join(ANALYTICS, f"grafana-outlier-detection-{fam}.json")
+        assert os.path.exists(path), f"missing dashboard for {fam}"
+        with open(path) as f:
+            dash = json.load(f)
+        exprs = " ".join(
+            t["expr"] for p in dash["panels"] for t in p["targets"]
+        )
+        assert "outlier_score_max" in exprs
+        assert "outlier_threshold" in exprs
+
+
+def test_alert_rules_reference_exported_metrics():
+    with open(os.path.join(ANALYTICS, "prometheus-rules.yaml")) as f:
+        rules = yaml.safe_load(f)
+    exprs = [
+        r["expr"]
+        for g in rules["spec"]["groups"]
+        for r in g["rules"]
+    ]
+    assert len(exprs) >= 5
+    for expr in exprs:
+        for name in _series_in(expr):
+            base = _strip_histogram_suffix(name)
+            assert base in EXPORTED | EXTERNAL, (
+                f"alert rule queries {name!r} which nothing exports"
+            )
+
+
+def test_alertmanager_config_parses_and_receives():
+    docs = list(yaml.safe_load_all(
+        open(os.path.join(ANALYTICS, "alertmanager.yaml"))
+    ))
+    cm = [d for d in docs if d and d["kind"] == "ConfigMap"][0]
+    cfg = yaml.safe_load(cm["data"]["alertmanager.yml"])
+    assert cfg["route"]["receiver"] == "default"
+    names = {r["name"] for r in cfg["receivers"]}
+    assert cfg["route"]["receiver"] in names
+    for route in cfg["route"].get("routes", []):
+        assert route["receiver"] in names
+    kinds = {d["kind"] for d in docs if d}
+    assert kinds == {"ConfigMap", "Deployment", "Service"}
+
+
+def test_exported_set_matches_code():
+    """Guard the EXPORTED list against drift: the names must literally
+    appear in the modules that register them."""
+    import inspect
+
+    from seldon_tpu.components import outliers
+    from seldon_tpu.runtime import metrics_server
+    from seldon_tpu.servers import jaxserver
+
+    source = (
+        inspect.getsource(metrics_server)
+        + inspect.getsource(outliers)
+        + inspect.getsource(jaxserver)
+    )
+    for name in EXPORTED:
+        assert name in source, f"{name} not found in exporting modules"

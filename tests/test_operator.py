@@ -209,10 +209,49 @@ def test_ambassador_yaml():
     assert "prefix: /seldon/test/mymodel/" in yaml_block
     assert "grpc: true" in yaml_block
     assert "retry_on: connect-failure" in yaml_block
+    assert "shadow" not in yaml_block
     import yaml as pyyaml
 
     docs = [d for d in pyyaml.safe_load_all(yaml_block) if d]
     assert len(docs) == 2
+    # Single predictor always gets full weight (ambassador.go:228-230).
+    assert all(d["weight"] == 100 for d in docs)
+
+
+def test_ambassador_shadow_and_header_routing():
+    """Reference ambassador.go:14-17,119-133: shadow mirroring + custom
+    exact/regex header routing + service-name/id overrides."""
+    import yaml as pyyaml
+
+    sdep = fixture_cr()
+    sdep.annotations[T.ANNOTATION_AMBASSADOR_SHADOW] = "true"
+    sdep.annotations[T.ANNOTATION_AMBASSADOR_HEADER] = "x-team: ml : x-env:prod"
+    sdep.annotations[T.ANNOTATION_AMBASSADOR_REGEX_HEADER] = "x-user: canary-.*"
+    sdep.annotations[T.ANNOTATION_AMBASSADOR_SERVICE] = "extname"
+    sdep.annotations[T.ANNOTATION_AMBASSADOR_ID] = "amb-a"
+    default_deployment(sdep)
+    docs = [
+        d for d in pyyaml.safe_load_all(ambassador_annotations(sdep)) if d
+    ]
+    assert len(docs) == 2
+    rest = [d for d in docs if not d.get("grpc")][0]
+    grpc = [d for d in docs if d.get("grpc")][0]
+    assert rest["shadow"] is True and grpc["shadow"] is True
+    assert rest["prefix"] == "/seldon/test/extname/"
+    assert rest["headers"] == {"x-team": "ml", "x-env": "prod"}
+    assert rest["regex_headers"] == {"x-user": "canary-.*"}
+    assert rest["ambassador_id"] == "amb-a"
+    # gRPC keeps its routing headers AND gains the custom ones; the
+    # seldon routing header follows the external service name.
+    assert grpc["headers"]["seldon"] == "extname"
+    assert grpc["headers"]["x-team"] == "ml"
+
+
+def test_ambassador_custom_config_override():
+    sdep = fixture_cr()
+    sdep.annotations[T.ANNOTATION_AMBASSADOR_CUSTOM] = "my: config\n"
+    default_deployment(sdep)
+    assert ambassador_annotations(sdep) == "my: config\n"
 
 
 def test_separate_engine_pod():

@@ -21,18 +21,20 @@ from seldon_tpu.models import get_config, init_params, transformer
 from seldon_tpu.models.sampling import sample_per_row
 
 PRESET = "bench-1b"
-SLOTS = 160
-WINDOW = 257  # prompt 128 + decode 128 + 1
+import os
+SLOTS = int(os.environ.get("MB_SLOTS", 160))
+WINDOW = int(os.environ.get("MB_WINDOW", 257))  # prompt 128 + decode 128 + 1
 CHUNK = 64
 
 
-def chunk_impl(params, state, *, cfg, n_steps):
+def chunk_impl(params, state, *, cfg, n_steps, kernel=False):
     Smax = state["cache"]["k"].shape[2]
 
     def step(carry, _):
         run = carry["active"]
         logits, cache = transformer.decode_step(
-            params, carry["last_tok"], carry["pos"], carry["cache"], cfg
+            params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
+            decode_kernel=kernel,
         )
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
@@ -56,7 +58,7 @@ def chunk_impl(params, state, *, cfg, n_steps):
     return state, toks
 
 
-def bench(weights: str, kv: str, attn: str = "xla") -> float:
+def bench(weights: str, kv: str, attn: str = "xla", kernel: bool = False) -> float:
     cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
                      attn_impl=attn)
     params = init_params(cfg, jax.random.key(0))
@@ -75,7 +77,7 @@ def bench(weights: str, kv: str, attn: str = "xla") -> float:
         "top_p": jnp.ones((B,), jnp.float32),
         "seeds": jnp.arange(B, dtype=jnp.uint32),
     }
-    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK),
+    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK, kernel=kernel),
                  donate_argnums=(1,))
 
     def one(state):
@@ -92,7 +94,7 @@ def bench(weights: str, kv: str, attn: str = "xla") -> float:
     ms_per_step = 1000.0 * dt / CHUNK
     toks_per_s = SLOTS * CHUNK / dt
     print(
-        f"w={weights:5s} kv={kv:5s} attn={attn:5s} "
+        f"w={weights:5s} kv={kv:5s} attn={attn:5s} krn={int(kernel)} "
         f"{ms_per_step:7.3f} ms/step  {toks_per_s:9.0f} tok/s",
         flush=True,
     )
@@ -103,4 +105,5 @@ if __name__ == "__main__":
     combos = sys.argv[1:] or ["int8:bf16", "int8:int8", "bf16:bf16", "bf16:int8"]
     for c in combos:
         parts = c.split(":")
-        bench(*parts)
+        kernel = len(parts) > 3 and parts[3] == "krn"
+        bench(*parts[:3] if len(parts) > 2 else parts, kernel=kernel)

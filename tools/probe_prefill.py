@@ -1,0 +1,74 @@
+"""Prefill/admission cost at serving shapes: group size x weights x attn.
+
+The bench showed admission (batched prefill) costs ~25 ms per [8,128]
+group — 1/3 of total bench time. This measures where it goes and what
+group size / attention impl / weight dtype do to it.
+"""
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from seldon_tpu.models import get_config, init_params, transformer
+from seldon_tpu.models.quantize import quantize_params
+from seldon_tpu.models.sampling import sample_per_row
+from tools.timing import slope_time
+
+SLOTS = 160
+WINDOW = 257
+SB = 128
+
+
+def admit_impl(params, state, toks, plens, slots, *, cfg):
+    """Mirror of engine._admit_impl (prefill + scatter + first sample)."""
+    G, Sb = toks.shape
+    sub = transformer.init_cache(cfg, G, Sb)
+    logits, sub = transformer.prefill(params, toks, plens, sub, cfg)
+    keys = jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+    )(jnp.arange(G, dtype=jnp.uint32), plens)
+    first = sample_per_row(
+        logits, keys, jnp.full((G,), 0.7), jnp.zeros((G,), jnp.int32),
+        jnp.ones((G,)))
+    cache = state["cache"]
+    new_cache = {
+        key: cache[key].at[:, slots, :, :Sb].set(
+            sub[key].astype(cache[key].dtype))
+        for key in cache
+    }
+    return {**state, "cache": new_cache}, first
+
+
+def run(G, weights, kv, attn):
+    cfg = get_config("bench-1b", weight_dtype=weights, kv_cache_dtype=kv,
+                     attn_impl=attn or "xla")
+    params = init_params(cfg, jax.random.key(0))
+    if weights == "int8":
+        params = quantize_params(params)
+    state = {"cache": transformer.init_cache(cfg, SLOTS, WINDOW)}
+    fn = jax.jit(functools.partial(admit_impl, cfg=cfg), donate_argnums=(1,))
+    toks = jnp.ones((G, SB), jnp.int32)
+    plens = jnp.full((G,), SB, jnp.int32)
+    slots = jnp.arange(G, dtype=jnp.int32)
+
+    def one(state):
+        state, first = fn(params, state, toks, plens, slots)
+        return state
+
+    dt, _ = slope_time(one, state, k1=3, k2=23)
+    tok_s = G * SB / dt
+    print(f"G={G:3d} w={weights:5s} attn={attn or 'xla':6s} "
+          f"{dt*1000:8.2f} ms/admission  {tok_s/1000:8.1f}k tok/s prefill",
+          flush=True)
+
+
+if __name__ == "__main__":
+    combos = sys.argv[1:] or [
+        "8:int8:", "16:int8:", "32:int8:", "8:bf16:", "32:bf16:",
+        "8:int8:flash", "32:int8:flash",
+    ]
+    for c in combos:
+        g, w, a = c.split(":")
+        run(int(g), w, "int8", a)

@@ -1,0 +1,70 @@
+"""Capture a device profile of the decode chunk and print the op-level
+time breakdown (parses the perfetto trace.json.gz jax.profiler emits)."""
+
+import functools
+import glob
+import gzip
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from seldon_tpu.models import get_config, init_params, transformer
+from seldon_tpu.models.quantize import quantize_params
+from tools.microbench_decode import chunk_impl, SLOTS, WINDOW, CHUNK
+
+
+def main():
+    kv = sys.argv[1] if len(sys.argv) > 1 else "int8"
+    wd = sys.argv[2] if len(sys.argv) > 2 else "int8"
+    cfg = get_config("bench-1b", kv_cache_dtype=kv, weight_dtype=wd)
+    params = init_params(cfg, jax.random.key(0))
+    if wd == "int8":
+        params = quantize_params(params)
+    B = SLOTS
+    state = {
+        "cache": transformer.init_cache(cfg, B, WINDOW),
+        "last_tok": jnp.ones((B,), jnp.int32),
+        "pos": jnp.full((B,), 128, jnp.int32),
+        "active": jnp.ones((B,), jnp.bool_),
+        "temp": jnp.full((B,), 0.7, jnp.float32),
+        "top_k": jnp.zeros((B,), jnp.int32),
+        "top_p": jnp.ones((B,), jnp.float32),
+        "seeds": jnp.arange(B, dtype=jnp.uint32),
+    }
+    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK),
+                 donate_argnums=(1,))
+    state, toks = fn(params, state)
+    _ = jax.device_get(toks)
+
+    outdir = "/tmp/jaxprof"
+    os.system(f"rm -rf {outdir}")
+    with jax.profiler.trace(outdir):
+        state, toks = fn(params, state)
+        _ = jax.device_get(toks)
+
+    files = glob.glob(f"{outdir}/**/*.trace.json.gz", recursive=True)
+    if not files:
+        print("NO TRACE FILES; dir contents:")
+        for f in glob.glob(f"{outdir}/**/*", recursive=True):
+            print(" ", f)
+        return
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X" and "dur" in e]
+    # Keep device-side events (TPU op track); aggregate by name.
+    agg = {}
+    for e in events:
+        name = e.get("name", "?")
+        agg[name] = agg.get(name, 0) + e["dur"]
+    total = sum(agg.values())
+    print(f"total traced op-us: {total} ({len(events)} events)")
+    for name, us in sorted(agg.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"{us/1000.0:9.2f} ms  {100.0*us/total:5.1f}%  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
