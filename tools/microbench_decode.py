@@ -28,7 +28,6 @@ CHUNK = 64
 
 
 def chunk_impl(params, state, *, cfg, n_steps, kernel=False):
-    Smax = state["cache"]["k"].shape[2]
 
     def step(carry, _):
         run = carry["active"]
