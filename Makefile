@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e bench bench-orchestrator native ci
+.PHONY: proto test test-e2e bench bench-orchestrator native native-tsan ci
 
 proto:
 	protoc --python_out=seldon_tpu/proto -I seldon_tpu/proto seldon_tpu/proto/prediction.proto
@@ -20,3 +20,6 @@ bench-orchestrator:
 	python bench_orchestrator.py
 
 ci: test test-e2e
+
+native-tsan:
+	$(MAKE) -C native tsan

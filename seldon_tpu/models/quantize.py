@@ -101,7 +101,7 @@ def init_params_int8(cfg, key: "jax.Array") -> Dict[str, Any]:
         q, s = _quantize_leaf(w)
         return wq.at[li].set(q), wsc.at[li].set(s)
 
-    def make_stacked(key, name, shape, sc):
+    def make_stacked(key, shape, sc):
         wq = jnp.zeros((L,) + shape, jnp.int8)
         # Per-layer scale shape mirrors _quantize_leaf's keepdims on the
         # -2 axis: (D,F)->(1,F); MoE (E,D,F)->(E,1,F).
@@ -139,7 +139,7 @@ def init_params_int8(cfg, key: "jax.Array") -> Dict[str, Any]:
             ("w_down", (E, F, D), out_scale),
         ]
     for name, shape, sc in leaf_shapes:
-        key, wq, wsc = make_stacked(key, name, shape, sc)
+        key, wq, wsc = make_stacked(key, shape, sc)
         blocks[name] = wq
         blocks[f"{name}_scale"] = wsc
 
