@@ -53,6 +53,10 @@ class EngineConfig:
     max_admit: int = 8  # largest batched-prefill group (power of two)
     decode_chunk: int = 8  # decode steps per dispatch (latency/thruput knob)
     idle_sleep_s: float = 0.002
+    # Boundary fetches on a dedicated thread so dispatches never wait on
+    # a host<->device round trip (auto-disabled on multi-process meshes:
+    # SPMD dispatch decisions must not depend on fetch timing).
+    async_fetch: bool = True
 
 
 @dataclasses.dataclass
@@ -120,6 +124,15 @@ class InferenceEngine:
 
         self._state = self._fresh_state()
         self._active_host = np.zeros((B,), bool)  # control-flow mirror
+        # Serializes slot/free-list/active bookkeeping between the
+        # scheduler thread and the boundary-fetcher thread.
+        self._book = threading.Lock()
+        self._async_fetch = (
+            self.ecfg.async_fetch and jax.process_count() == 1
+        )
+        self._fetch_q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._fetcher: Optional[threading.Thread] = None
+        self._dispatch_wreck = None  # partial boundary for error paths
 
         # Host-side bookkeeping.
         self._slots: List[Optional[_Request]] = [None] * B
@@ -355,6 +368,11 @@ class InferenceEngine:
     def start(self):
         if self._thread is None:
             self._stop.clear()  # allow stop() -> start() restart
+            if self._async_fetch:
+                self._fetcher = threading.Thread(
+                    target=self._fetch_loop, daemon=True
+                )
+                self._fetcher.start()
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
@@ -363,6 +381,18 @@ class InferenceEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._fetcher is not None:
+            # Sentinel AFTER the last real item; bounded retries so a
+            # dead/wedged fetcher can't hang shutdown on a full queue.
+            for _ in range(60):
+                try:
+                    self._fetch_q.put(None, timeout=0.5)
+                    break
+                except queue.Full:
+                    if not self._fetcher.is_alive():
+                        break
+            self._fetcher.join(timeout=30)
+            self._fetcher = None
 
     def warmup(self) -> None:
         """Pre-compile every (prompt-bucket x group-size) admission variant
@@ -502,6 +532,8 @@ class InferenceEngine:
             now = time.perf_counter()
             ttft_total = 0.0
             for i, req in enumerate(group):
+                if req.finished:  # already failed by an error path
+                    continue
                 slot = req.slot
                 first_tok = int(first_h[i])
                 req.first_token_at = now
@@ -621,6 +653,44 @@ class InferenceEngine:
                     self._active_host[slot] = False
                     self._free.append(slot)
 
+    def _drain_and_fail(self, err: str, current=None) -> None:
+        """Async-mode failure: drain every queued boundary (their rosters
+        may hold requests already recycled out of _slots) and fail the
+        lot — called under NO lock; takes _book itself."""
+        pendings = [current] if current is not None else []
+        while True:
+            try:
+                item = self._fetch_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                pendings.append(item)
+        with self._book:
+            self._fail_all(err, pendings)
+
+    def _fetch_loop(self) -> None:
+        """Boundary-fetcher thread: device_get (a full host<->device
+        round trip) runs OUTSIDE the bookkeeping lock, so the scheduler
+        keeps dispatching while results travel; only the host-side
+        processing serializes with it. A request's first token therefore
+        costs ~one round trip under load instead of two."""
+        while True:
+            item = self._fetch_q.get()
+            if item is None:
+                return
+            admits, chunk_handles, roster = item
+            try:
+                admit_data, chunk_data = jax.device_get(
+                    ([(f, d) for _, f, d in admits], chunk_handles)
+                )
+                with self._book:
+                    self._process_admits(admits, admit_data)
+                    if chunk_data is not None:
+                        self._process_chunk(*chunk_data, roster)
+            except Exception as e:
+                logger.exception("boundary fetch failed")
+                self._drain_and_fail(str(e), current=item)
+
     def _loop(self) -> None:
         # Software-pipelined scheduler: chunk N+1 is dispatched BEFORE
         # chunk N's results are fetched, so the host fetch (one device
@@ -632,7 +702,69 @@ class InferenceEngine:
         # attribution exact). Length-bounded rows free their slots at
         # DISPATCH time (_recycle_budget_spent), so the pipeline never
         # drains at wave boundaries; EOS-finished rows free one boundary
-        # late.
+        # late. With async_fetch (single-process), fetches run on a
+        # dedicated thread (_fetch_loop) and this loop NEVER blocks on a
+        # round trip; multi-process meshes keep the synchronous variant
+        # so SPMD dispatch decisions stay timing-independent.
+        if self._async_fetch:
+            self._loop_async()
+        else:
+            self._loop_sync()
+
+    def _dispatch_once(self):
+        """One scheduling step under the bookkeeping lock. Returns the
+        (admits, chunk_handles, roster) boundary or None if idle. On an
+        exception, self._dispatch_wreck holds the partial boundary so
+        the error path can fail recycled-out-of-_slots requests."""
+        self._dispatch_wreck = None
+        admits = self._dispatch_admits()
+        self._dispatch_wreck = (admits, None, None)
+        if admits or self._active_host.any():
+            roster = list(self._slots)
+            self._dispatch_wreck = (admits, None, roster)
+            self._state, toks, valid, active_after = self._jit_chunk(
+                self.params, self._state
+            )
+            self._recycle_budget_spent(roster)
+            # Start the host copies NOW: the fetcher's device_get then
+            # finds data already in flight, so boundary fetches overlap
+            # each other instead of serializing one round trip each
+            # (the fetcher was the pipeline bottleneck at small decode
+            # chunks, where a chunk computes faster than one round trip).
+            for _, f, d in admits:
+                f.copy_to_host_async()
+                d.copy_to_host_async()
+            for h in (toks, valid, active_after):
+                h.copy_to_host_async()
+            self._dispatch_wreck = None
+            return (admits, (toks, valid, active_after), roster)
+        self._dispatch_wreck = None
+        return None
+
+    def _loop_async(self) -> None:
+        while not self._stop.is_set():
+            work = None
+            try:
+                with self._book:
+                    work = self._dispatch_once()
+            except Exception as e:
+                logger.exception("engine dispatch failed")
+                # _dispatch_once may have recycled requests out of
+                # _slots before failing; they live only in its roster.
+                self._drain_and_fail(
+                    str(e), current=self._dispatch_wreck
+                )
+                self._dispatch_wreck = None
+                continue
+            if work is not None:
+                # Bounded queue (maxsize=4): caps how far the host's
+                # slot-state view may lag behind retired boundaries.
+                # Blocks OUTSIDE the lock, so the fetcher keeps draining.
+                self._fetch_q.put(work)
+            elif self._pending.empty():
+                time.sleep(self.ecfg.idle_sleep_s)
+
+    def _loop_sync(self) -> None:
         pending: Optional[Tuple[list, Any, list]] = None
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path

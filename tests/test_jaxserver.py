@@ -261,3 +261,57 @@ def test_engine_bad_request_fails_cleanly(engine):
         [3, 4], SamplingParams(temperature=1.0, max_new_tokens=2, seed=2**80)
     )
     assert len(ok["token_ids"]) >= 1
+
+
+def test_engine_async_dispatch_failure_fails_all_clients():
+    """A dispatch error must fail EVERY in-flight request — including ones
+    optimistically recycled out of the slot table and ones whose
+    boundaries sit in the fetch queue — with an error + terminator, never
+    a hang (round-3 review finding on the async fetcher)."""
+    import jax
+
+    from seldon_tpu.models import get_config, init_params
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = InferenceEngine(params, cfg, EngineConfig(
+        max_slots=4, max_seq_len=48, prompt_buckets=(8,), decode_chunk=4))
+    eng.warmup()
+
+    real_chunk = eng._jit_chunk
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("injected device error")
+        return real_chunk(*a, **k)
+
+    eng._jit_chunk = flaky
+    # 8 requests / 4 slots: two waves, so the failure lands while some
+    # requests wait and some are mid-decode/recycled.
+    qs = [eng.submit([3 + i] * 5, SamplingParams(
+        temperature=0.5, max_new_tokens=12, seed=i)) for i in range(8)]
+    eng.start()
+    outcomes = []
+    for q in qs:
+        saw_error, toks, terminated = False, 0, False
+        while True:
+            item = q.get(timeout=60)  # a hang here IS the failure mode
+            if item is None:
+                terminated = True
+                break
+            if "error" in item:
+                saw_error = True
+            else:
+                toks += len(item["tokens"])
+            assert not (saw_error and "tokens" in item), \
+                "tokens after error"
+        outcomes.append((saw_error, toks, terminated))
+    eng.stop()
+    assert all(t for _, _, t in outcomes), outcomes
+    # The injected error must have actually failed someone (not all
+    # requests can have finished cleanly before call #3).
+    assert any(e for e, _, _ in outcomes), outcomes
