@@ -41,6 +41,10 @@ KIND_ROUTES: Dict[str, Tuple[str, str]] = {
                         "destinationrules"),
     "SeldonDeployment": ("apis/machinelearning.seldon.io/v1alpha3",
                          "seldondeployments"),
+    # Read-only kinds for credential injection (operator/credentials.py).
+    "ConfigMap": ("api/v1", "configmaps"),
+    "Secret": ("api/v1", "secrets"),
+    "ServiceAccount": ("api/v1", "serviceaccounts"),
 }
 
 
@@ -166,6 +170,19 @@ class KubeStore:
         except KubeApiError as e:
             if e.status != 404:
                 raise
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Dict]:
+        """Single-object GET (None on 404) — credential injection reads
+        ConfigMap/ServiceAccount/Secret by name without the O(namespace)
+        payload of a LIST."""
+        try:
+            obj = self._req("GET", self._url(kind, namespace, name))
+        except KubeApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        obj.setdefault("kind", kind)
+        return obj
 
     def list(self, kind: str, namespace: str,
              label_selector: Optional[Dict[str, str]] = None) -> List[Dict]:

@@ -168,11 +168,8 @@ def _model_initializer(unit: PredictiveUnit) -> Dict:
     return {
         "name": f"{unit.name}-model-initializer",
         "image": T.DEFAULT_SERVER_IMAGE,
-        "command": [
-            "python", "-c",
-            "import sys; from seldon_tpu.servers.storage import download; "
-            f"download({unit.model_uri!r}, '/mnt/models')",
-        ],
+        "command": ["python", "-m", "seldon_tpu.servers.storage"],
+        "args": [unit.model_uri, "/mnt/models"],
         "volumeMounts": [
             {"name": _model_volume_name(unit), "mountPath": "/mnt/models"}
         ],
@@ -225,9 +222,12 @@ def _engine_container(sdep: T.SeldonDeployment, pred: T.PredictorExt) -> Dict:
 
 
 def build_predictor_manifests(
-    sdep: T.SeldonDeployment, pred: T.PredictorExt
+    sdep: T.SeldonDeployment, pred: T.PredictorExt,
+    credentials: Optional["CredentialBuilder"] = None,
 ) -> List[Dict]:
-    """Deployment(+engine) + Services for one predictor."""
+    """Deployment(+engine) + Services for one predictor. `credentials`
+    (operator/credentials.py) injects storage secrets into the
+    model-initializer initContainers for private gs://-/s3:// model URIs."""
     manifests: List[Dict] = []
     dep_name = T.predictor_deployment_name(sdep, pred)
     labels = {
@@ -247,7 +247,12 @@ def build_predictor_manifests(
             continue
         containers.append(_unit_container(sdep, pred, unit))
         if unit.model_uri:
-            init_containers.append(_model_initializer(unit))
+            init = _model_initializer(unit)
+            if credentials is not None:
+                credentials.inject(
+                    sdep.namespace, pred.service_account_name, init, volumes
+                )
+            init_containers.append(init)
             volumes.append(
                 {"name": _model_volume_name(unit), "emptyDir": {}}
             )
@@ -682,7 +687,14 @@ def build_istio_manifests(sdep: T.SeldonDeployment) -> List[Dict]:
 
 
 def _parse_header_annotation(value: str) -> Dict[str, str]:
-    """'key1:val1:key2:val2' -> dict (reference ambassador.go:100-117)."""
+    """'key1:val1:key2:val2' -> dict (reference ambassador.go:100-117).
+
+    The wire format is inherently ambiguous when a VALUE contains ':'
+    (a regex like 'x-ver:v[12]:x-env:prod.*' still parses, but
+    'x-match:a:b' would mis-pair) — same limitation as the reference's
+    strings.Split. Each key may appear once; a trailing unpaired token
+    (odd part count) is dropped rather than silently becoming a key
+    with the next pair's key as its value."""
     parts = value.split(":")
     out: Dict[str, str] = {}
     for i in range(0, len(parts) - 1, 2):
@@ -723,7 +735,12 @@ def ambassador_annotations(sdep: T.SeldonDeployment) -> str:
     def header_yaml(tag: str, headers: Dict[str, str]) -> str:
         if not headers:
             return ""
-        lines = "".join(f"  {k}: {v}\n" for k, v in headers.items())
+        # json.dumps double-quotes values (valid YAML scalars), so regex
+        # patterns with ':', '{', or leading specials can't malform the
+        # emitted Mapping.
+        lines = "".join(
+            f"  {k}: {json.dumps(str(v))}\n" for k, v in headers.items()
+        )
         return f"{tag}:\n{lines}"
 
     extras = ""
@@ -787,9 +804,16 @@ class Reconciler:
         self.istio_enabled = istio_enabled
 
     def desired_manifests(self, sdep: T.SeldonDeployment) -> List[Dict]:
+        from seldon_tpu.operator.credentials import CredentialBuilder
+
+        credentials = CredentialBuilder.from_store(
+            self.store, namespaces=("seldon-system", sdep.namespace)
+        )
         manifests: List[Dict] = []
         for pred in sdep.predictors:
-            manifests.extend(build_predictor_manifests(sdep, pred))
+            manifests.extend(
+                build_predictor_manifests(sdep, pred, credentials)
+            )
             if pred.hpa is not None:
                 manifests.append(build_hpa_manifest(sdep, pred))
             manifests.extend(build_explainer_manifests(sdep, pred))

@@ -149,6 +149,9 @@ class PredictorExt:
     resources: Dict[str, Dict] = dataclasses.field(default_factory=dict)
     hpa: Optional[HpaSpec] = None
     explainer: Optional[ExplainerSpec] = None
+    # ServiceAccount whose secrets carry model-storage credentials
+    # (operator/credentials.py; reference service_account_credentials.go).
+    service_account_name: str = ""
 
     @staticmethod
     def from_dict(d: Dict) -> "PredictorExt":
@@ -157,6 +160,7 @@ class PredictorExt:
             tpu=TPUSpec.from_dict(d.get("tpu", {})),
             component_images=dict(d.get("componentImages", {})),
             resources=dict(d.get("resources", {})),
+            service_account_name=d.get("serviceAccountName", ""),
             hpa=(
                 HpaSpec.from_dict(d["hpaSpec"]) if d.get("hpaSpec") else None
             ),
@@ -179,6 +183,8 @@ class PredictorExt:
             out["hpaSpec"] = self.hpa.to_dict()
         if self.explainer is not None:
             out["explainer"] = self.explainer.to_dict()
+        if self.service_account_name:
+            out["serviceAccountName"] = self.service_account_name
         return out
 
 

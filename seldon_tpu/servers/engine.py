@@ -155,8 +155,9 @@ class InferenceEngine:
         )
         # Pallas decode-attention kernel (layer-indexed, pre-write cache,
         # in-kernel int8 dequant — ops/decode_attention.py). Single-chip
-        # TPU only: pallas doesn't auto-partition under GSPMD.
-        # SELDON_TPU_DECODE_KERNEL=0 reverts to the XLA einsum path.
+        # TPU only: pallas doesn't auto-partition under GSPMD. OPT-IN via
+        # SELDON_TPU_DECODE_KERNEL=1; the default is the XLA einsum path,
+        # which measured faster at serving shapes (COVERAGE.md).
         import os as _os
 
         from seldon_tpu.ops.decode_attention import _on_tpu

@@ -195,6 +195,27 @@ def _download_azure_blob(uri: str, out_dir: str | None) -> str:
     return target
 
 
+def _s3_client_kwargs(env) -> dict:
+    """boto3 client kwargs from the operator-injected credential env
+    (operator/credentials.py; reference s3_secret.go env contract).
+    AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY are read by boto3 itself;
+    this handles the endpoint/SSL knobs: AWS_ENDPOINT_URL wins, else
+    S3_ENDPOINT + S3_USE_HTTPS compose one, and S3_VERIFY_SSL=0 disables
+    certificate verification (self-hosted minio with self-signed TLS)."""
+    kwargs: dict = {}
+    endpoint = env.get("AWS_ENDPOINT_URL")
+    if not endpoint and env.get("S3_ENDPOINT"):
+        scheme = "http" if env.get("S3_USE_HTTPS") == "0" else "https"
+        endpoint = f"{scheme}://{env['S3_ENDPOINT']}"
+    if endpoint:
+        kwargs["endpoint_url"] = endpoint
+    if env.get("S3_VERIFY_SSL") == "0":
+        kwargs["verify"] = False
+    if env.get("AWS_REGION"):
+        kwargs["region_name"] = env["AWS_REGION"]
+    return kwargs
+
+
 def _download_s3(uri: str, out_dir: str | None) -> str:
     try:
         import boto3
@@ -205,9 +226,7 @@ def _download_s3(uri: str, out_dir: str | None) -> str:
         ) from e
     bucket_name, _, prefix = uri[len("s3://"):].partition("/")
     target = _target_dir(out_dir)
-    s3 = boto3.client(
-        "s3", endpoint_url=os.environ.get("AWS_ENDPOINT_URL") or None
-    )
+    s3 = boto3.client("s3", **_s3_client_kwargs(os.environ))
     paginator = s3.get_paginator("list_objects_v2")
     for page in paginator.paginate(Bucket=bucket_name, Prefix=prefix):
         for obj in page.get("Contents", []):
@@ -218,3 +237,25 @@ def _download_s3(uri: str, out_dir: str | None) -> str:
             os.makedirs(os.path.dirname(dst) or target, exist_ok=True)
             s3.download_file(bucket_name, obj["Key"], dst)
     return target
+
+
+def main(argv=None) -> int:
+    """Model-initializer initContainer entrypoint:
+    `python -m seldon_tpu.servers.storage <uri> <out_dir>` (the operator's
+    _model_initializer emits this command; credentials arrive via the
+    injected env — operator/credentials.py)."""
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 2:
+        print("usage: python -m seldon_tpu.servers.storage <uri> <out_dir>",
+              file=sys.stderr)
+        return 2
+    logging.basicConfig(level=logging.INFO)
+    local = download(args[0], args[1])
+    print(local)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

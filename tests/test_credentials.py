@@ -1,0 +1,275 @@
+"""Storage credential injection (operator/credentials.py).
+
+Mirrors the reference's credential test semantics
+(operator/controllers/resources/credentials/s3/s3_secret_test.go:1-187,
+service_account_credentials.go:64-113): S3 secrets become secretKeyRef
+envs + annotation-driven endpoint envs; GCS secrets become a mounted
+volume + GOOGLE_APPLICATION_CREDENTIALS."""
+
+import base64
+
+from seldon_tpu.operator import types as T
+from seldon_tpu.operator.credentials import (
+    CONFIGMAP_NAME,
+    CredentialBuilder,
+    CredentialConfig,
+    build_s3_envs,
+)
+from seldon_tpu.operator.reconciler import (
+    InMemoryStore,
+    build_predictor_manifests,
+)
+
+KF = "serving.kubeflow.org"
+SELDON = "machinelearning.seldon.io"
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _secret(name, data, annotations=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": annotations or {}},
+        "data": {k: _b64(v) for k, v in data.items()},
+    }
+
+
+def _sa(name, secret_names):
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": name, "namespace": "default"},
+        "secrets": [{"name": n} for n in secret_names],
+    }
+
+
+def _env_map(envs):
+    return {e["name"]: e for e in envs}
+
+
+# --- build_s3_envs scenarios (s3_secret_test.go table) ----------------------
+
+
+def test_s3_secret_envs_endpoint_annotation():
+    secret = _secret(
+        "s3-secret", {"awsAccessKeyID": "k", "awsSecretAccessKey": "s"},
+        annotations={KF + "/s3-endpoint": "s3.aws.com"},
+    )
+    envs = _env_map(build_s3_envs(secret, CredentialConfig().s3))
+    assert envs["AWS_ACCESS_KEY_ID"]["valueFrom"]["secretKeyRef"] == {
+        "name": "s3-secret", "key": "awsAccessKeyID"
+    }
+    assert envs["AWS_SECRET_ACCESS_KEY"]["valueFrom"]["secretKeyRef"] == {
+        "name": "s3-secret", "key": "awsSecretAccessKey"
+    }
+    assert envs["S3_ENDPOINT"]["value"] == "s3.aws.com"
+    assert envs["AWS_ENDPOINT_URL"]["value"] == "https://s3.aws.com"
+    assert "S3_USE_HTTPS" not in envs
+
+
+def test_s3_secret_https_and_ssl_override():
+    secret = _secret(
+        "s3-secret", {},
+        annotations={
+            KF + "/s3-endpoint": "s3.aws.com",
+            KF + "/s3-usehttps": "0",
+            KF + "/s3-verifyssl": "0",
+        },
+    )
+    envs = _env_map(build_s3_envs(secret, CredentialConfig().s3))
+    assert envs["S3_USE_HTTPS"]["value"] == "0"
+    assert envs["AWS_ENDPOINT_URL"]["value"] == "http://s3.aws.com"
+    assert envs["S3_VERIFY_SSL"]["value"] == "0"
+
+
+def test_s3_seldon_group_wins_over_kubeflow():
+    secret = _secret(
+        "s3-secret", {},
+        annotations={
+            SELDON + "/s3-endpoint": "minio.svc:9000",
+            KF + "/s3-endpoint": "other",
+            SELDON + "/s3-region": "eu-west-1",
+        },
+    )
+    envs = _env_map(build_s3_envs(secret, CredentialConfig().s3))
+    assert envs["S3_ENDPOINT"]["value"] == "minio.svc:9000"
+    assert envs["AWS_REGION"]["value"] == "eu-west-1"
+
+
+def test_s3_configmap_endpoint_fallback_and_custom_key_names():
+    cfg = CredentialConfig.from_configmap({
+        "data": {
+            "credentials": (
+                '{"s3": {"s3AccessKeyIDName": "AKID", '
+                '"s3SecretAccessKeyName": "SAK", '
+                '"s3Endpoint": "minio:9000", "s3UseHttps": "0"}}'
+            )
+        }
+    })
+    secret = _secret("s3-secret", {})
+    envs = _env_map(build_s3_envs(secret, cfg.s3))
+    assert envs["AWS_ACCESS_KEY_ID"]["valueFrom"]["secretKeyRef"]["key"] == "AKID"
+    assert envs["AWS_SECRET_ACCESS_KEY"]["valueFrom"]["secretKeyRef"]["key"] == "SAK"
+    assert envs["AWS_ENDPOINT_URL"]["value"] == "http://minio:9000"
+    assert envs["S3_USE_HTTPS"]["value"] == "0"
+
+
+# --- ServiceAccount walk + injection into the initContainer -----------------
+
+
+def _deploy_with_sa(store, sa_name="model-sa"):
+    sdep = T.SeldonDeployment.from_dict({
+        "metadata": {"name": "dep", "namespace": "default"},
+        "spec": {
+            "predictors": [{
+                "name": "p",
+                "serviceAccountName": sa_name,
+                "graph": {
+                    "name": "clf",
+                    "implementation": "SKLEARN_SERVER",
+                    "modelUri": "s3://bucket/model",
+                },
+            }]
+        },
+    })
+    creds = CredentialBuilder.from_store(store)
+    manifests = build_predictor_manifests(sdep, sdep.predictors[0], creds)
+    dep = next(m for m in manifests if m["kind"] == "Deployment")
+    pod = dep["spec"]["template"]["spec"]
+    return pod
+
+
+def test_s3_secret_injected_into_initcontainer():
+    store = InMemoryStore()
+    store.apply(_secret(
+        "s3-secret", {"awsAccessKeyID": "k", "awsSecretAccessKey": "s"},
+        annotations={SELDON + "/s3-endpoint": "minio:9000"},
+    ))
+    store.apply(_sa("model-sa", ["s3-secret"]))
+    pod = _deploy_with_sa(store)
+    init = pod["initContainers"][0]
+    envs = _env_map(init["env"])
+    assert envs["AWS_ACCESS_KEY_ID"]["valueFrom"]["secretKeyRef"]["name"] == "s3-secret"
+    assert envs["S3_ENDPOINT"]["value"] == "minio:9000"
+    # Secret VALUES never appear in the manifest (only secretKeyRef).
+    import json as _json
+
+    assert "awsAccessKeyID" not in _json.dumps(init).replace(
+        '"key": "awsAccessKeyID"', "")
+
+
+def test_gcs_secret_injected_as_volume():
+    store = InMemoryStore()
+    store.apply(_secret(
+        "gcs-secret", {"gcloud-application-credentials.json": "{}"}
+    ))
+    store.apply(_sa("model-sa", ["gcs-secret"]))
+    pod = _deploy_with_sa(store)
+    init = pod["initContainers"][0]
+    envs = _env_map(init["env"])
+    assert envs["GOOGLE_APPLICATION_CREDENTIALS"]["value"] == (
+        "/var/secrets/gcloud-application-credentials.json"
+    )
+    mounts = {m["name"]: m for m in init["volumeMounts"]}
+    assert mounts["user-gcp-sa"]["mountPath"] == "/var/secrets/"
+    assert mounts["user-gcp-sa"]["readOnly"] is True
+    vols = {v["name"]: v for v in pod["volumes"]}
+    assert vols["user-gcp-sa"]["secret"]["secretName"] == "gcs-secret"
+
+
+def test_missing_sa_or_secret_is_not_fatal():
+    store = InMemoryStore()
+    pod = _deploy_with_sa(store, sa_name="nope")
+    init = pod["initContainers"][0]
+    assert not init.get("env")
+    # SA exists but its secret doesn't: skipped, still builds.
+    store.apply(_sa("model-sa", ["ghost-secret"]))
+    pod = _deploy_with_sa(store)
+    assert not pod["initContainers"][0].get("env")
+
+
+def test_first_match_wins_no_duplicate_mounts():
+    """Two GCS secrets + two S3 secrets on one SA: only the FIRST of each
+    family is injected (duplicate env names / identical mountPaths would
+    fail apiserver validation)."""
+    store = InMemoryStore()
+    for n in ("gcs-a", "gcs-b"):
+        store.apply(_secret(n, {"gcloud-application-credentials.json": "{}"}))
+    for n in ("s3-a", "s3-b"):
+        store.apply(_secret(n, {"awsAccessKeyID": "k",
+                                "awsSecretAccessKey": "s"}))
+    store.apply(_sa("model-sa", ["gcs-a", "gcs-b", "s3-a", "s3-b"]))
+    pod = _deploy_with_sa(store)
+    init = pod["initContainers"][0]
+    names = [e["name"] for e in init["env"]]
+    assert names.count("GOOGLE_APPLICATION_CREDENTIALS") == 1
+    assert names.count("AWS_ACCESS_KEY_ID") == 1
+    assert [m["name"] for m in init["volumeMounts"]].count("user-gcp-sa") == 1
+    ref = next(e for e in init["env"] if e["name"] == "AWS_ACCESS_KEY_ID")
+    assert ref["valueFrom"]["secretKeyRef"]["name"] == "s3-a"
+
+
+def test_non_matching_secret_skipped():
+    store = InMemoryStore()
+    store.apply(_secret("token-secret", {"token": "abc"}))
+    store.apply(_sa("model-sa", ["token-secret"]))
+    pod = _deploy_with_sa(store)
+    assert not pod["initContainers"][0].get("env")
+
+
+def test_nameless_secret_ref_skipped():
+    """ObjectReference.name is optional: a SA with secrets: [{}] must not
+    crash the reconcile (a nameless get would hit the collection URL)."""
+    store = InMemoryStore()
+    sa = _sa("model-sa", [])
+    sa["secrets"] = [{}]
+    store.apply(sa)
+    pod = _deploy_with_sa(store)
+    assert not pod["initContainers"][0].get("env")
+
+
+def test_configmap_discovery_and_custom_gcs_filename():
+    store = InMemoryStore()
+    store.apply({
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": CONFIGMAP_NAME, "namespace": "seldon-system"},
+        "data": {"credentials": '{"gcs": {"gcsCredentialFileName": "sa.json"}}'},
+    })
+    store.apply(_secret("gcs-secret", {"sa.json": "{}"}))
+    store.apply(_sa("model-sa", ["gcs-secret"]))
+    pod = _deploy_with_sa(store)
+    envs = _env_map(pod["initContainers"][0]["env"])
+    assert envs["GOOGLE_APPLICATION_CREDENTIALS"]["value"] == (
+        "/var/secrets/sa.json"
+    )
+
+
+# --- storage.py consumes the injected env -----------------------------------
+
+
+def test_s3_client_kwargs_from_env():
+    from seldon_tpu.servers.storage import _s3_client_kwargs
+
+    assert _s3_client_kwargs({}) == {}
+    assert _s3_client_kwargs({"AWS_ENDPOINT_URL": "https://x"}) == {
+        "endpoint_url": "https://x"
+    }
+    kw = _s3_client_kwargs({
+        "S3_ENDPOINT": "minio:9000", "S3_USE_HTTPS": "0",
+        "S3_VERIFY_SSL": "0", "AWS_REGION": "us-east-1",
+    })
+    assert kw == {
+        "endpoint_url": "http://minio:9000",
+        "verify": False,
+        "region_name": "us-east-1",
+    }
+    # AWS_ENDPOINT_URL wins over S3_ENDPOINT composition.
+    kw = _s3_client_kwargs({
+        "AWS_ENDPOINT_URL": "https://real", "S3_ENDPOINT": "other",
+    })
+    assert kw["endpoint_url"] == "https://real"

@@ -131,6 +131,135 @@ def test_sklearn_server_binary_sigmoid(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# MLFlowServer: native MLmodel parsing, no mlflow installed
+# (reference servers/mlflowserver/mlflowserver/MLFlowServer.py:12-49)
+# ---------------------------------------------------------------------------
+
+
+def _write_mlflow_dir(tmp_path, model, flavor_yaml: str,
+                      pkl_name="model.pkl"):
+    import pickle
+
+    (tmp_path / pkl_name).write_bytes(pickle.dumps(model))
+    (tmp_path / "MLmodel").write_text(flavor_yaml)
+
+
+def test_mlflow_sklearn_flavor_without_mlflow(tmp_path):
+    """sklearn-flavor mlflow dir serves natively (mlflow absent in this
+    image by design); logistic models ride the jitted linear path and
+    match sklearn's own predict_proba."""
+    import sys
+
+    assert "mlflow" not in sys.modules
+    from sklearn.linear_model import LogisticRegression
+
+    from seldon_tpu.servers.mlflowserver import MLFlowServer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(80, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    clf = LogisticRegression().fit(X, y)
+    _write_mlflow_dir(
+        tmp_path, clf,
+        "flavors:\n"
+        "  python_function:\n"
+        "    loader_module: mlflow.sklearn\n"
+        "    model_path: model.pkl\n"
+        "  sklearn:\n"
+        "    pickled_model: model.pkl\n"
+        "    serialization_format: cloudpickle\n"
+        "    sklearn_version: 1.9.0\n",
+    )
+    srv = MLFlowServer(model_uri=str(tmp_path), method="predict_proba")
+    srv.load()
+    assert srv._predict_jit is not None  # linear fast path engaged
+    Xt = rng.normal(size=(5, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        srv.predict(Xt, []), clf.predict_proba(Xt), rtol=2e-3, atol=2e-4
+    )
+    labels = MLFlowServer(model_uri=str(tmp_path), method="predict")
+    np.testing.assert_array_equal(labels.predict(Xt, []), clf.predict(Xt))
+
+
+def test_mlflow_pyfunc_descriptor_only(tmp_path):
+    """python_function-only descriptor (loader_module mlflow.sklearn)
+    resolves to the same native loader; regressors return 1-D output."""
+    from sklearn.linear_model import Ridge
+
+    from seldon_tpu.servers.mlflowserver import MLFlowServer
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(60, 4))
+    y = X @ np.array([1.0, -2.0, 0.5, 0.0]) + 3.0
+    reg = Ridge().fit(X, y)
+    _write_mlflow_dir(
+        tmp_path, reg,
+        "flavors:\n"
+        "  python_function:\n"
+        "    loader_module: mlflow.sklearn\n"
+        "    model_path: model.pkl\n",
+    )
+    srv = MLFlowServer(model_uri=str(tmp_path))
+    Xt = rng.normal(size=(7, 4)).astype(np.float32)
+    out = srv.predict(Xt, [])
+    assert out.shape == (7,)
+    np.testing.assert_allclose(out, reg.predict(Xt), rtol=1e-3, atol=1e-3)
+
+
+def test_mlflow_nonlinear_estimator_falls_back_to_sklearn(tmp_path):
+    """Tree models (no coef_) predict through the unpickled estimator."""
+    from sklearn.ensemble import RandomForestClassifier
+
+    from seldon_tpu.servers.mlflowserver import MLFlowServer
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(50, 3))
+    y = (X[:, 0] > 0).astype(int)
+    clf = RandomForestClassifier(n_estimators=5, random_state=0).fit(X, y)
+    _write_mlflow_dir(
+        tmp_path, clf,
+        "flavors:\n  sklearn:\n    pickled_model: model.pkl\n",
+    )
+    srv = MLFlowServer(model_uri=str(tmp_path), method="predict_proba")
+    Xt = rng.normal(size=(4, 3))
+    np.testing.assert_allclose(srv.predict(Xt, []), clf.predict_proba(Xt))
+
+
+def test_mlflow_margin_classifier_no_jit_path(tmp_path):
+    """LinearSVC has coef_/classes_ but no predict_proba: the jitted
+    softmax path must NOT engage (it would argmax a [B,1] margin column
+    to constant class 0); predictions route through the estimator."""
+    from sklearn.svm import LinearSVC
+
+    from seldon_tpu.servers.mlflowserver import MLFlowServer
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(60, 3))
+    y = (X[:, 0] - X[:, 2] > 0).astype(int)
+    clf = LinearSVC().fit(X, y)
+    _write_mlflow_dir(
+        tmp_path, clf,
+        "flavors:\n  sklearn:\n    pickled_model: model.pkl\n",
+    )
+    srv = MLFlowServer(model_uri=str(tmp_path), method="predict")
+    Xt = rng.normal(size=(8, 3))
+    srv.predict(Xt, [])
+    assert srv._predict_jit is None
+    np.testing.assert_array_equal(srv.predict(Xt, []), clf.predict(Xt))
+
+
+def test_mlflow_exotic_flavor_clear_error(tmp_path):
+    from seldon_tpu.servers.mlflowserver import MLFlowServer
+
+    (tmp_path / "MLmodel").write_text(
+        "flavors:\n  pytorch:\n    model_data: data\n"
+    )
+    srv = MLFlowServer(model_uri=str(tmp_path))
+    with pytest.raises(RuntimeError, match="pytorch"):
+        srv.load()
+
+
+# ---------------------------------------------------------------------------
 # TFServingProxy against a fake TF-Serving REST endpoint
 # ---------------------------------------------------------------------------
 

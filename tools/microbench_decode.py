@@ -20,8 +20,8 @@ import jax.numpy as jnp
 from seldon_tpu.models import get_config, init_params, transformer
 from seldon_tpu.models.sampling import sample_per_row
 
-PRESET = "bench-1b"
 import os
+PRESET = os.environ.get("MB_PRESET", "bench-1b")
 SLOTS = int(os.environ.get("MB_SLOTS", 160))
 WINDOW = int(os.environ.get("MB_WINDOW", 257))  # prompt 128 + decode 128 + 1
 CHUNK = 64
@@ -60,11 +60,13 @@ def chunk_impl(params, state, *, cfg, n_steps, kernel=False):
 def bench(weights: str, kv: str, attn: str = "xla", kernel: bool = False) -> float:
     cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
                      attn_impl=attn)
-    params = init_params(cfg, jax.random.key(0))
     if weights == "int8":
-        from seldon_tpu.models.quantize import quantize_params
+        # Memory-aware: 8B geometry can't materialize bf16 then quantize.
+        from seldon_tpu.models.quantize import init_params_int8
 
-        params = quantize_params(params)
+        params = init_params_int8(cfg, jax.random.key(0))
+    else:
+        params = init_params(cfg, jax.random.key(0))
     B = SLOTS
     state = {
         "cache": transformer.init_cache(cfg, B, WINDOW),

@@ -1,0 +1,137 @@
+"""Probe: int4 weight storage on this TPU. Native s4 jit arguments hit a
+device_put recursion bug in this jax build, so int4 must ride PACKED in
+int8 (two nibbles per byte) and unpack inside the consuming jit. This
+times the llama3-8b MLP layer scan for: bf16, int8 per-channel, packed
+int4 with group scales (two unpack variants), to see whether the nibble
+unpack fuses into the matmul operand read (HBM traffic halves) or
+materializes (traffic worse than int8).
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 96
+D, F, L = 4096, 14336, 8
+CHUNK = 16
+GROUP = 128
+
+
+def quant8(w):
+    s = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    return jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8), s
+
+
+@jax.jit
+def quant4_packed(w):
+    """[.., K, N] -> (uint8 packed [.., K//2, N], scale [.., K//GROUP, 1, N]).
+    Byte k holds w[2k] in the low nibble, w[2k+1] in the high nibble,
+    both offset-7 biased (value range [-7, 7] -> [0, 14])."""
+    *lead, K, N = w.shape
+    wg = w.reshape(*lead, K // GROUP, GROUP, N)
+    s = jnp.maximum(jnp.max(jnp.abs(wg), axis=-2, keepdims=True) / 7.0, 1e-12)
+    q = jnp.clip(jnp.round(wg / s), -7, 7).astype(jnp.int8)
+    q = q.reshape(*lead, K, N) + 7  # [0, 14]
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    packed = (lo | (hi << 4)).astype(jnp.uint8)
+    return packed, s.astype(jnp.float32)
+
+
+def unpack4_interleave(packed, s, dtype):
+    """packed [.., K//2, N] -> bf16 [.., K, N] via stack+reshape."""
+    *lead, Kh, N = packed.shape
+    lo = (packed & 0xF).astype(jnp.int8) - 7
+    hi = (packed >> 4).astype(jnp.int8) - 7
+    w = jnp.stack([lo, hi], axis=-2)  # [.., K//2, 2, N]
+    w = w.reshape(*lead, Kh * 2, N).astype(dtype)
+    G = s.shape[-3]
+    wf = w.reshape(*lead, G, (Kh * 2) // G, N) * s.astype(dtype)
+    return wf.reshape(*lead, Kh * 2, N)
+
+
+def run(name, layer_fn, weights):
+    @jax.jit
+    def f(x, weights):
+        def step(x, _):
+            def body(h, ws):
+                return layer_fn(h, ws), ()
+
+            h, _ = jax.lax.scan(body, x, weights)
+            return h * 1e-3 + x[0, 0] * 0, ()
+
+        x, _ = jax.lax.scan(step, x, None, length=CHUNK)
+        return x
+
+    from tools.timing import slope_time
+
+    x = jnp.ones((B, 1, D), jnp.bfloat16)
+    dt, _ = slope_time(lambda s: f(s, weights), x, k1=2, k2=8)
+    print(f"{name:16s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+    return dt / CHUNK
+
+
+def main():
+    ks = jax.random.split(jax.random.key(0), 3)
+    wg = jax.random.normal(ks[0], (L, D, F), jnp.float32) * 0.02
+    wu = jax.random.normal(ks[1], (L, D, F), jnp.float32) * 0.02
+    wd = jax.random.normal(ks[2], (L, F, D), jnp.float32) * 0.02
+
+    bf = tuple(w.astype(jnp.bfloat16) for w in (wg, wu, wd))
+    q8 = sum((quant8(w) for w in (wg, wu, wd)), ())
+    q4 = sum((tuple(quant4_packed(w)) for w in (wg, wu, wd)), ())
+
+    def layer_bf16(h, ws):
+        g, u, d = ws
+        return h + jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.silu(jnp.einsum("bsd,df->bsf", h, g))
+            * jnp.einsum("bsd,df->bsf", h, u), d)
+
+    def layer_q8(h, ws):
+        g, sg, u, su, d, sd = ws
+        dq = lambda q, s: q.astype(h.dtype) * s.astype(h.dtype)
+        return layer_bf16(h, (dq(g, sg), dq(u, su), dq(d, sd)))
+
+    def layer_q4(h, ws):
+        g, sg, u, su, d, sd = ws
+        return layer_bf16(
+            h, (unpack4_interleave(g, sg, h.dtype),
+                unpack4_interleave(u, su, h.dtype),
+                unpack4_interleave(d, sd, h.dtype)))
+
+    def layer_q4_split(h, ws):
+        """Two-matmul variant: even/odd K rows as separate fused-dequant
+        int8-pattern matmuls; x sliced even/odd (tiny)."""
+        g, sg, u, su, d, sd = ws
+
+        def mm(x, packed, s):  # x [B,1,K] @ w [K,N]
+            *lead, Kh, N = packed.shape
+            G = s.shape[-3]
+            half = s  # group scales apply to both nibbles (groups >= 2)
+
+            def deq(nib):
+                w = nib.astype(h.dtype).reshape(*lead, G, Kh // G, N)
+                return (w * half.astype(h.dtype)).reshape(*lead, Kh, N)
+
+            lo = deq((packed & 0xF).astype(jnp.int8) - 7)
+            hi = deq((packed >> 4).astype(jnp.int8) - 7)
+            return (jnp.einsum("bsk,kn->bsn", x[..., 0::2], lo)
+                    + jnp.einsum("bsk,kn->bsn", x[..., 1::2], hi))
+
+        gate = jax.nn.silu(mm(h, g, sg)) * mm(h, u, su)
+        return h + mm(gate, d, sd)
+
+    gb_bf = 3 * D * F * L * 2 / 1e9
+    t_bf = run("bf16", layer_bf16, bf)
+    t_8 = run("int8", layer_q8, q8)
+    t_4 = run("int4-interleave", layer_q4, q4)
+    t_4s = run("int4-split", layer_q4_split, q4)
+    print(f"layer HBM bf16={gb_bf:.2f}GB  eff BW: "
+          f"bf16={gb_bf/t_bf:.0f}  int8={gb_bf/2/t_8:.0f}  "
+          f"int4-il={gb_bf/4/t_4:.0f}  int4-sp={gb_bf/4/t_4s:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,17 +12,20 @@ import jax
 import jax.numpy as jnp
 
 from seldon_tpu.models import get_config, init_params, transformer
-from seldon_tpu.models.quantize import quantize_params
 from tools.microbench_decode import chunk_impl, SLOTS, WINDOW, CHUNK
 
 
 def main():
     kv = sys.argv[1] if len(sys.argv) > 1 else "int8"
     wd = sys.argv[2] if len(sys.argv) > 2 else "int8"
-    cfg = get_config("bench-1b", kv_cache_dtype=kv, weight_dtype=wd)
-    params = init_params(cfg, jax.random.key(0))
+    cfg = get_config(os.environ.get("MB_PRESET", "bench-1b"),
+                     kv_cache_dtype=kv, weight_dtype=wd)
     if wd == "int8":
-        params = quantize_params(params)
+        from seldon_tpu.models.quantize import init_params_int8
+
+        params = init_params_int8(cfg, jax.random.key(0))
+    else:
+        params = init_params(cfg, jax.random.key(0))
     B = SLOTS
     state = {
         "cache": transformer.init_cache(cfg, B, WINDOW),
