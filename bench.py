@@ -49,7 +49,13 @@ BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 SLO_TTFT_MS = 100.0  # BASELINE.md north star: p50 TTFT < 100 ms
 SLO_ENABLED = os.environ.get("BENCH_SLO", "1") == "1"
-SLO_CHUNK = int(os.environ.get("BENCH_SLO_CHUNK", 4))
+# The SLO search runs the SAME engine config as the throughput leg:
+# occupancy-adaptive chunking (EngineConfig.adaptive_chunk) picks short
+# chunks in the under-capacity latency regime and the full decode_chunk
+# at saturation, so one engine holds both claims — the old
+# chunk-4-for-SLO mode switch is gone. BENCH_SLO_CHUNK pins a fixed
+# chunk for A/B comparison.
+SLO_CHUNK = int(os.environ.get("BENCH_SLO_CHUNK", 0))  # 0 = adaptive
 
 
 def _measure_slo(params, cfg, sp) -> dict:
@@ -67,12 +73,15 @@ def _measure_slo(params, cfg, sp) -> dict:
 
     from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
 
+    # Default (SLO_CHUNK=0): the throughput config itself — adaptive
+    # chunking must hold the SLO without a mode switch.
     ecfg = EngineConfig(
         max_slots=SLOTS,
         max_seq_len=PROMPT_LEN + NEW_TOKENS + 1,
         prompt_buckets=(PROMPT_LEN,),
         max_admit=8,
-        decode_chunk=SLO_CHUNK,
+        decode_chunk=SLO_CHUNK or DECODE_CHUNK,
+        adaptive_chunk=not SLO_CHUNK,
     )
     engine = InferenceEngine(params, cfg, ecfg)
     engine.warmup()
@@ -193,7 +202,7 @@ def _measure_slo(params, cfg, sp) -> dict:
         "slo_target_ms": SLO_TTFT_MS,
         "slo_target_effective_ms": round(target, 1),
         "slo_unloaded_floor_ms": round(floor, 1),
-        "slo_decode_chunk": SLO_CHUNK,
+        "slo_decode_chunk": SLO_CHUNK or f"adaptive<= {DECODE_CHUNK}",
     }
 
 

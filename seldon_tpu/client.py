@@ -44,12 +44,20 @@ class SeldonClient:
         grpc_port: int = 5001,
         transport: str = "grpc",  # "grpc" | "rest" | "rest-proto"
         timeout_s: float = 30.0,
+        deployment: str = "",
+        namespace: str = "default",
     ):
         self.host = host
         self.port = port
         self.grpc_port = grpc_port
         self.transport = transport
         self.timeout_s = timeout_s
+        # Gateway routing identity: gRPC ingresses (ambassador Mapping,
+        # reconciler.ambassador_annotations) route Seldon RPCs on the
+        # `seldon`/`namespace` metadata — sent on every gRPC call when
+        # `deployment` is set. REST uses gateway_prefix() paths instead.
+        self.deployment = deployment
+        self.namespace = namespace
         self._channel = None
 
     # --- plumbing -----------------------------------------------------------
@@ -100,8 +108,14 @@ class SeldonClient:
         import grpc
 
         stub = prediction_grpc.STUBS[service](self._grpc_channel())
+        metadata = (
+            [("seldon", self.deployment), ("namespace", self.namespace)]
+            if self.deployment else None
+        )
         try:
-            out = getattr(stub, method)(message, timeout=self.timeout_s)
+            out = getattr(stub, method)(
+                message, timeout=self.timeout_s, metadata=metadata
+            )
         except grpc.RpcError as e:
             return ClientResponse(False, error=f"{e.code().name}: {e.details()}")
         return ClientResponse(True, msg=out)
@@ -120,13 +134,25 @@ class SeldonClient:
 
     # --- API ----------------------------------------------------------------
 
+    @staticmethod
+    def gateway_prefix(namespace: str, deployment: str) -> str:
+        """Ingress route prefix for a deployed SeldonDeployment — the path
+        Ambassador/Istio rewrite onto the engine
+        (reconciler.ambassador_annotations / build_istio_manifests;
+        reference seldon_client gateway='ambassador')."""
+        return f"/seldon/{namespace}/{deployment}"
+
     def predict(self, data=None, names=None, payload_kind="dense",
-                msg=None) -> ClientResponse:
+                msg=None, gateway_prefix: str = "") -> ClientResponse:
         """Predict via the engine's external API (Seldon.Predict /
-        /api/v0.1/predictions)."""
+        /api/v0.1/predictions). `gateway_prefix` routes through an
+        ingress instead of a bare engine (REST only — gRPC ingresses
+        route on the seldon/namespace metadata headers, which
+        _grpc_call already sends)."""
         request = self._build_request(data, payload_kind, names, msg)
         if self.transport.startswith("rest"):
-            return self._rest("/api/v0.1/predictions", request, pb.SeldonMessage)
+            path = f"{gateway_prefix.rstrip('/')}/api/v0.1/predictions"
+            return self._rest(path, request, pb.SeldonMessage)
         return self._grpc_call("Seldon", "Predict", request, pb.SeldonMessage)
 
     def explain(self, data=None, names=None, payload_kind="dense",
@@ -162,7 +188,7 @@ class SeldonClient:
         )
 
     def feedback(self, request_msg=None, response_msg=None, reward=0.0,
-                 truth=None) -> ClientResponse:
+                 truth=None, gateway_prefix: str = "") -> ClientResponse:
         fb = pb.Feedback(reward=float(reward))
         if request_msg is not None:
             fb.request.CopyFrom(request_msg)
@@ -174,7 +200,8 @@ class SeldonClient:
                 else payloads.build_message(np.asarray(truth))
             )
         if self.transport.startswith("rest"):
-            return self._rest("/api/v0.1/feedback", fb, pb.SeldonMessage)
+            path = f"{gateway_prefix.rstrip('/')}/api/v0.1/feedback"
+            return self._rest(path, fb, pb.SeldonMessage)
         return self._grpc_call("Seldon", "SendFeedback", fb, pb.SeldonMessage)
 
     _MICROSERVICE_METHODS = {

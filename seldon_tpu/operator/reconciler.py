@@ -272,6 +272,12 @@ def build_predictor_manifests(
     }
 
     pod_spec: Dict[str, Any] = {"containers": list(containers)}
+    if pred.service_account_name:
+        # The pod runs AS this SA too — identity-based bucket access
+        # (GKE Workload Identity) works without any key secrets; the
+        # secret walk above only adds long-lived-key credentials when
+        # the SA actually carries them.
+        pod_spec["serviceAccountName"] = pred.service_account_name
     if init_containers:
         pod_spec["initContainers"] = init_containers
     if not separate_engine:

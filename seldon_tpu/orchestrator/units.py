@@ -23,10 +23,10 @@ class SimpleModelUnit:
     class_names = ["proba0", "proba1", "proba2"]
 
     def transform_input(self, msg: pb.SeldonMessage) -> pb.SeldonMessage:
+        kind = payloads.data_kind(msg)
         out = payloads.build_message(
             self.values, names=self.class_names,
-            kind=payloads.data_kind(msg) if payloads.data_kind(msg) in
-            ("dense", "tensor", "ndarray") else "dense",
+            kind=kind if kind in ("dense", "tensor", "ndarray") else "dense",
         )
         out.meta.CopyFrom(msg.meta)
         return out

@@ -109,6 +109,9 @@ class PredictorEngine:
         # Per-unit span name + attributes are static per spec: building
         # the f-string + identity dict per request showed up in the hot
         # path profile even with tracing disabled.
+        self._all_hardcoded = self.batcher is None and all(
+            u.name in self._hardcoded for u in spec.graph.walk()
+        )
         self._span_info = {
             u.name: (
                 f"unit.{u.name}",
@@ -116,6 +119,38 @@ class PredictorEngine:
             )
             for u in spec.graph.walk()
         }
+
+    @property
+    def all_hardcoded(self) -> bool:
+        """True when every unit runs in-process (no network hops) and no
+        micro-batcher is interposed — the graph walk then never suspends,
+        so predict()/send_feedback() coroutines can be driven to
+        completion without an event loop (predict_sync). Cached at
+        __init__ (spec/batcher/_hardcoded are fixed): it's read per
+        fan-out node per request on the serving hot path."""
+        return self._all_hardcoded
+
+    @staticmethod
+    def drive_sync(coro):
+        """Run a coroutine that never actually awaits IO to completion on
+        the calling thread. Raises RuntimeError if it suspends (a
+        network unit sneaked into a supposedly in-process graph)."""
+        try:
+            coro.send(None)
+        except StopIteration as e:
+            return e.value
+        coro.close()
+        raise RuntimeError(
+            "graph walk suspended: predict_sync requires a fully "
+            "in-process (hardcoded, unbatched) graph"
+        )
+
+    def predict_sync(self, request: pb.SeldonMessage,
+                     trace_parent=None) -> pb.SeldonMessage:
+        """Synchronous predict for fully in-process graphs — the sync
+        gRPC servicer path (orchestrator/server.py) calls this from
+        worker threads with zero event-loop involvement."""
+        return self.drive_sync(self.predict(request, trace_parent))
 
     # --- forward path -------------------------------------------------------
 
@@ -126,8 +161,12 @@ class PredictorEngine:
     ) -> pb.SeldonMessage:
         puid = request.meta.puid or make_puid()
         ctx = _RequestCtx(puid)
-        msg = pb.SeldonMessage()
-        msg.CopyFrom(request)
+        # The engine owns the request message (every caller — REST parse,
+        # gRPC servicers — hands over a per-request object): stamping the
+        # puid in place saves a full message copy per request on the hot
+        # path, and the logged request then carries its puid like the
+        # reference's.
+        msg = request
         msg.meta.puid = puid
         with self.tracer.span(
             "engine.predict", parent=trace_parent, attributes={"puid": puid}
@@ -174,9 +213,24 @@ class PredictorEngine:
                     f"branch {branch} out of range ({len(unit.children)} children)",
                 )
             selected = [unit.children[branch]]
-        child_outputs = await asyncio.gather(
-            *(self._get_output(transformed, c, ctx) for c in selected)
-        )
+        if len(selected) == 1:
+            # Direct await: no task/future churn for the common
+            # single-branch case (routers, chains).
+            child_outputs = [
+                await self._get_output(transformed, selected[0], ctx)
+            ]
+        elif self.all_hardcoded:
+            # Fully in-process graph: children never touch the network, so
+            # sequential awaits complete without suspending — this keeps
+            # the whole predict() coroutine synchronously drivable
+            # (predict_sync) with identical results.
+            child_outputs = [
+                await self._get_output(transformed, c, ctx) for c in selected
+            ]
+        else:
+            child_outputs = await asyncio.gather(
+                *(self._get_output(transformed, c, ctx) for c in selected)
+            )
 
         # (6) aggregate
         merged = await self._aggregate(list(child_outputs), unit, hard, ctx)
@@ -293,9 +347,16 @@ class PredictorEngine:
             )
         else:
             children = unit.children
-        await asyncio.gather(
-            *(self._send_feedback(feedback, c) for c in children)
-        )
+        if len(children) == 1 or self.all_hardcoded:
+            # Mirrors the predict-path rule: keeps the coroutine
+            # synchronously drivable for in-process graphs (the sync gRPC
+            # servicer) and skips task churn for single-branch mirrors.
+            for c in children:
+                await self._send_feedback(feedback, c)
+        elif children:
+            await asyncio.gather(
+                *(self._send_feedback(feedback, c) for c in children)
+            )
 
     async def close(self):
         await self.client.close()

@@ -140,6 +140,66 @@ def run_contract_test(
     }
 
 
+def run_api_test(
+    contract_path: str,
+    host: str = "localhost",
+    port: int = 8000,
+    grpc_port: int = 0,
+    transport: str = "rest",
+    n_requests: int = 10,
+    batch_size: int = 2,
+    deployment: str = "",
+    namespace: str = "default",
+    with_feedback: bool = False,
+    payload_kind: str = "ndarray",
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Contract-fuzz a DEPLOYED endpoint — the engine's external API,
+    optionally through an ingress gateway (reference api_tester.py:1-140:
+    predict + send-feedback against a running SeldonDeployment, not a
+    bare microservice). Set `deployment` to route via the gateway:
+    REST uses the /seldon/{ns}/{name} path prefix, gRPC the
+    seldon/namespace routing metadata."""
+    with open(contract_path) as f:
+        contract = json.load(f)
+    rng = np.random.default_rng(seed)
+    client = SeldonClient(
+        host=host, port=port, grpc_port=grpc_port or port,
+        transport=transport, deployment=deployment, namespace=namespace,
+    )
+    prefix = (
+        SeldonClient.gateway_prefix(namespace, deployment)
+        if deployment else ""
+    )
+    failures = []
+    for i in range(n_requests):
+        X, names = generate_batch(contract, batch_size, rng)
+        kind = payload_kind if X.dtype.kind in "fiub" else "ndarray"
+        r = client.predict(
+            data=X, names=names, payload_kind=kind, gateway_prefix=prefix
+        )
+        if not r.success:
+            failures.append(f"request {i}: {r.error}")
+            continue
+        failures.extend(
+            f"request {i}: {p}" for p in validate_response(contract, r.data)
+        )
+        if not r.msg.meta.puid:
+            failures.append(f"request {i}: response missing meta.puid")
+        if with_feedback:
+            fr = client.feedback(
+                response_msg=r.msg, reward=1.0, gateway_prefix=prefix
+            )
+            if not fr.success:
+                failures.append(f"feedback {i}: {fr.error}")
+    client.close()
+    return {
+        "requests": n_requests,
+        "failures": failures,
+        "ok": not failures,
+    }
+
+
 def main(argv=None):  # pragma: no cover - CLI
     import argparse
 
@@ -151,13 +211,31 @@ def main(argv=None):  # pragma: no cover - CLI
     p.add_argument("-n", "--n-requests", type=int, default=10)
     p.add_argument("-b", "--batch-size", type=int, default=2)
     p.add_argument("--method", default="predict")
+    # Deployed-endpoint mode (reference api_tester.py): fuzz the engine /
+    # ingress instead of a bare microservice.
+    p.add_argument("--api", action="store_true",
+                   help="target a deployed engine/ingress, not a unit")
+    p.add_argument("--deployment", default="",
+                   help="route via gateway prefix /seldon/<ns>/<name>")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--feedback", action="store_true",
+                   help="send reward feedback after each prediction")
     args = p.parse_args(argv)
-    result = run_contract_test(
-        args.contract, args.host, args.port,
-        transport="grpc" if args.grpc else "rest",
-        n_requests=args.n_requests, batch_size=args.batch_size,
-        method=args.method,
-    )
+    if args.api or args.deployment:
+        result = run_api_test(
+            args.contract, args.host, args.port,
+            transport="grpc" if args.grpc else "rest",
+            n_requests=args.n_requests, batch_size=args.batch_size,
+            deployment=args.deployment, namespace=args.namespace,
+            with_feedback=args.feedback,
+        )
+    else:
+        result = run_contract_test(
+            args.contract, args.host, args.port,
+            transport="grpc" if args.grpc else "rest",
+            n_requests=args.n_requests, batch_size=args.batch_size,
+            method=args.method,
+        )
     print(json.dumps(result, indent=1))
     return 0 if result["ok"] else 1
 

@@ -57,6 +57,16 @@ class EngineConfig:
     # a host<->device round trip (auto-disabled on multi-process meshes:
     # SPMD dispatch decisions must not depend on fetch timing).
     async_fetch: bool = True
+    # Prefill-priority scheduling: scale the dispatched chunk length with
+    # slot occupancy so ONE engine holds both the TTFT SLO and saturated
+    # throughput. A request can only be admitted at a chunk boundary;
+    # with mostly-free slots (under-capacity, latency-sensitive regime) a
+    # long chunk is pure admission latency, while at saturation nothing
+    # can be admitted mid-chunk anyway — so: near-empty -> min_chunk
+    # boundaries, near-full -> decode_chunk. Compiles one chunk variant
+    # per power-of-two rung (min_chunk..decode_chunk).
+    adaptive_chunk: bool = True
+    min_chunk: int = 4
 
 
 @dataclasses.dataclass
@@ -170,16 +180,31 @@ class InferenceEngine:
             and n_mesh_devices == 1
             and _on_tpu()
         )
-        self._jit_chunk = jax.jit(
-            functools.partial(
-                self._chunk_impl,
-                cfg=self.cfg,
-                n_steps=max(1, self.ecfg.decode_chunk),
-                decode_kernel=self._decode_kernel,
-                mesh=mesh,
-            ),
-            donate_argnums=(1,),
-        )
+        # Chunk-length ladder: exactly the three rungs the policy uses
+        # (min / geometric mid / top) — every rung costs a full chunk
+        # compile, so no speculative intermediates.
+        # adaptive_chunk=False keeps the single fixed length.
+        top = max(1, self.ecfg.decode_chunk)
+        if self.ecfg.adaptive_chunk and top > self.ecfg.min_chunk:
+            lo = max(1, min(self.ecfg.min_chunk, top))
+            mid = 1 << int(round((lo * top) ** 0.5)).bit_length() - 1
+            sizes = [lo, mid, top]
+        else:
+            sizes = [top]
+        self._chunk_sizes = tuple(sorted(set(sizes)))
+        self._jit_chunks = {
+            n: jax.jit(
+                functools.partial(
+                    self._chunk_impl,
+                    cfg=self.cfg,
+                    n_steps=n,
+                    decode_kernel=self._decode_kernel,
+                    mesh=mesh,
+                ),
+                donate_argnums=(1,),
+            )
+            for n in self._chunk_sizes
+        }
 
     def _fresh_state(self) -> Dict[str, Any]:
         B, Smax = self.ecfg.max_slots, self.ecfg.max_seq_len
@@ -420,12 +445,16 @@ class InferenceEngine:
                     jnp.ones((G,), jnp.int32),
                     jnp.arange(G, dtype=jnp.int32),
                 )
-        # All slots inactive: pure compile + masked no-op writes.
-        self._state, _, _, _ = self._jit_chunk(self.params, self._state)
+        # All slots inactive: pure compile + masked no-op writes, one per
+        # chunk-ladder rung.
+        for n in self._chunk_sizes:
+            self._state, _, _, _ = self._jit_chunks[n](
+                self.params, self._state
+            )
         jax.block_until_ready(self._state["last_tok"])
         logger.info(
-            "engine warmed: %d admission variants + decode chunk",
-            len(self._buckets) * len(sizes),
+            "engine warmed: %d admission variants + %d decode chunk sizes",
+            len(self._buckets) * len(sizes), len(self._chunk_sizes),
         )
 
     # --- scheduler loop -----------------------------------------------------
@@ -634,7 +663,33 @@ class InferenceEngine:
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
 
-    def _recycle_budget_spent(self, roster: List[Optional[_Request]]) -> None:
+    def _pick_chunk(self) -> int:
+        """Prefill-priority chunk policy: admissions only happen at chunk
+        boundaries, so a long chunk is admission LATENCY whenever an
+        arrival could actually be admitted. Long chunks are therefore
+        reserved for saturation — when fewer than max_admit slots are
+        free, a mid-chunk arrival would have waited for completions
+        anyway, so the full decode_chunk costs nothing and amortizes the
+        host round trip. With real free capacity, boundaries stay at
+        min_chunk so TTFT tracks the unloaded floor (one engine holds
+        both the SLO and the saturated-throughput claims — the policy
+        the old chunk-4-vs-64 mode switch approximated by hand)."""
+        sizes = self._chunk_sizes
+        if len(sizes) == 1:
+            return sizes[0]
+        n_slots = len(self._slots)
+        free = sum(1 for r in self._slots if r is None)
+        # Thresholds scale with the pool so tiny test engines (where
+        # max_admit ~ max_slots) don't read "half empty" as saturated.
+        sat = min(self._max_admit, (n_slots + 7) // 8)
+        if free < sat:
+            return sizes[-1]  # saturated: nothing admittable mid-chunk
+        if free < n_slots // 4:
+            return sizes[len(sizes) // 2]  # near-saturation: split the cost
+        return sizes[0]
+
+    def _recycle_budget_spent(self, roster: List[Optional[_Request]],
+                              chunk_len: int) -> None:
         """Optimistic slot recycling: `expected` is an upper bound on the
         tokens a row will have produced once every dispatched chunk
         retires, and the device-side `remaining` counter guarantees a row
@@ -647,7 +702,7 @@ class InferenceEngine:
         for slot, req in enumerate(roster):
             if req is None or req.finished:
                 continue
-            req.expected += max(1, self.ecfg.decode_chunk)
+            req.expected += max(1, chunk_len)
             if req.expected >= req.params.max_new_tokens:
                 if self._slots[slot] is req:
                     self._slots[slot] = None
@@ -723,10 +778,11 @@ class InferenceEngine:
         if admits or self._active_host.any():
             roster = list(self._slots)
             self._dispatch_wreck = (admits, None, roster)
-            self._state, toks, valid, active_after = self._jit_chunk(
+            n = self._pick_chunk()
+            self._state, toks, valid, active_after = self._jit_chunks[n](
                 self.params, self._state
             )
-            self._recycle_budget_spent(roster)
+            self._recycle_budget_spent(roster, n)
             # Start the host copies NOW: the fetcher's device_get then
             # finds data already in flight, so boundary fetches overlap
             # each other instead of serializing one round trip each
@@ -776,11 +832,12 @@ class InferenceEngine:
                     # `active` is already armed even though _active_host
                     # lags until _process_admits.
                     roster = list(self._slots)
-                    self._state, toks, valid, active_after = self._jit_chunk(
-                        self.params, self._state
+                    n = self._pick_chunk()
+                    self._state, toks, valid, active_after = (
+                        self._jit_chunks[n](self.params, self._state)
                     )
                     chunk_handles = (toks, valid, active_after)
-                    self._recycle_budget_spent(roster)
+                    self._recycle_budget_spent(roster, n)
                 else:
                     chunk_handles = None
                 if pending is not None:

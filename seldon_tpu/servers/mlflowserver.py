@@ -30,6 +30,13 @@ from seldon_tpu.servers.storage import download
 logger = logging.getLogger(__name__)
 
 _LINEAR_ATTRS = ("coef_", "intercept_")
+# Estimators whose predict/predict_proba really are a plain (identity-
+# link) linear map + softmax/sigmoid — safe for the jitted fast path.
+_LINEAR_FAST_PATH_CLASSES = frozenset({
+    "LinearRegression", "Ridge", "RidgeCV", "Lasso", "LassoCV",
+    "ElasticNet", "ElasticNetCV", "LogisticRegression",
+    "LogisticRegressionCV",
+})
 
 
 def parse_mlmodel(local: str) -> Dict:
@@ -124,6 +131,12 @@ class MLFlowServer:
         TPU re-execution SKLearnServer applies to npz exports."""
         m = self.model
         if not all(hasattr(m, a) for a in _LINEAR_ATTRS):
+            return
+        # Identity-link models only: GLMs (Poisson/Tweedie/Gamma) also
+        # carry coef_/intercept_ but their predict() applies an inverse
+        # link, and OvR-normalized linear classifiers don't softmax —
+        # a raw matmul would silently return wrong values for those.
+        if m.__class__.__name__ not in _LINEAR_FAST_PATH_CLASSES:
             return
         is_classifier = hasattr(m, "classes_")
         if is_classifier and not hasattr(m, "predict_proba"):

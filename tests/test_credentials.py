@@ -221,6 +221,15 @@ def test_non_matching_secret_skipped():
     assert not pod["initContainers"][0].get("env")
 
 
+def test_pod_runs_as_predictor_service_account():
+    """The pod itself must run AS the CR's serviceAccountName, so
+    identity-based (secretless, e.g. Workload Identity) bucket access
+    works even when the SA carries no key secrets."""
+    store = InMemoryStore()
+    pod = _deploy_with_sa(store, sa_name="model-sa")
+    assert pod["serviceAccountName"] == "model-sa"
+
+
 def test_nameless_secret_ref_skipped():
     """ObjectReference.name is optional: a SA with secrets: [{}] must not
     crash the reconcile (a nameless get would hit the collection URL)."""

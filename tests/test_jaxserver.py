@@ -280,16 +280,18 @@ def test_engine_async_dispatch_failure_fails_all_clients():
         max_slots=4, max_seq_len=48, prompt_buckets=(8,), decode_chunk=4))
     eng.warmup()
 
-    real_chunk = eng._jit_chunk
+    real_chunks = dict(eng._jit_chunks)
     calls = {"n": 0}
 
-    def flaky(*a, **k):
-        calls["n"] += 1
-        if calls["n"] == 3:
-            raise RuntimeError("injected device error")
-        return real_chunk(*a, **k)
+    def flaky_for(n):
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("injected device error")
+            return real_chunks[n](*a, **k)
+        return flaky
 
-    eng._jit_chunk = flaky
+    eng._jit_chunks = {n: flaky_for(n) for n in eng._chunk_sizes}
     # 8 requests / 4 slots: two waves, so the failure lands while some
     # requests wait and some are mid-decode/recycled.
     qs = [eng.submit([3 + i] * 5, SamplingParams(
@@ -315,3 +317,46 @@ def test_engine_async_dispatch_failure_fails_all_clients():
     # The injected error must have actually failed someone (not all
     # requests can have finished cleanly before call #3).
     assert any(e for e, _, _ in outcomes), outcomes
+
+
+def test_engine_adaptive_chunk_policy():
+    """Prefill-priority scheduling: chunk length scales with occupancy —
+    empty slots -> min_chunk (frequent admission boundaries), full ->
+    decode_chunk; adaptive_chunk=False pins the single configured size."""
+    import jax
+
+    from seldon_tpu.models import get_config, init_params
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = InferenceEngine(params, cfg, EngineConfig(
+        max_slots=8, max_seq_len=48, prompt_buckets=(8,),
+        decode_chunk=32, min_chunk=4))
+    assert eng._chunk_sizes == (4, 8, 32)
+    assert eng._pick_chunk() == 4  # all free
+
+    class _Stub:  # occupancy is counted from non-None slot entries
+        finished = False
+
+    eng._slots = [_Stub()] * 8
+    assert eng._pick_chunk() == 32  # full -> saturated
+    eng._slots = [_Stub()] * 4 + [None] * 4
+    assert eng._pick_chunk() == 4  # real capacity -> fast admission
+    # Bigger pool: free below max_admit -> saturated; free below a
+    # quarter of the pool -> mid rung; plenty free -> min.
+    big = InferenceEngine(params, cfg, EngineConfig(
+        max_slots=64, max_seq_len=48, prompt_buckets=(8,),
+        decode_chunk=32, min_chunk=4, max_admit=8))
+    big._slots = [_Stub()] * 60 + [None] * 4
+    assert big._pick_chunk() == 32
+    big._slots = [_Stub()] * 52 + [None] * 12
+    assert big._pick_chunk() == 8
+    big._slots = [_Stub()] * 30 + [None] * 34
+    assert big._pick_chunk() == 4
+
+    fixed = InferenceEngine(params, cfg, EngineConfig(
+        max_slots=8, max_seq_len=48, prompt_buckets=(8,),
+        decode_chunk=32, adaptive_chunk=False))
+    assert fixed._chunk_sizes == (32,)
+    assert fixed._pick_chunk() == 32

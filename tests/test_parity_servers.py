@@ -248,6 +248,30 @@ def test_mlflow_margin_classifier_no_jit_path(tmp_path):
     np.testing.assert_array_equal(srv.predict(Xt, []), clf.predict(Xt))
 
 
+def test_mlflow_glm_keeps_inverse_link(tmp_path):
+    """PoissonRegressor exposes coef_/intercept_ but predict() applies
+    exp(link): the raw-matmul fast path must NOT engage, or the server
+    would silently return log-space values."""
+    from sklearn.linear_model import PoissonRegressor
+
+    from seldon_tpu.servers.mlflowserver import MLFlowServer
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(80, 3))
+    y = rng.poisson(np.exp(0.3 * X[:, 0] + 1.0))
+    reg = PoissonRegressor().fit(X, y)
+    _write_mlflow_dir(
+        tmp_path, reg,
+        "flavors:\n  sklearn:\n    pickled_model: model.pkl\n",
+    )
+    srv = MLFlowServer(model_uri=str(tmp_path))
+    Xt = rng.normal(size=(6, 3))
+    out = srv.predict(Xt, [])
+    assert srv._predict_jit is None
+    np.testing.assert_allclose(out, reg.predict(Xt))
+    assert (out > 0).all()  # rate space, not log space
+
+
 def test_mlflow_exotic_flavor_clear_error(tmp_path):
     from seldon_tpu.servers.mlflowserver import MLFlowServer
 
