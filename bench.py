@@ -202,7 +202,7 @@ def _measure_slo(params, cfg, sp) -> dict:
         "slo_target_ms": SLO_TTFT_MS,
         "slo_target_effective_ms": round(target, 1),
         "slo_unloaded_floor_ms": round(floor, 1),
-        "slo_decode_chunk": SLO_CHUNK or f"adaptive<= {DECODE_CHUNK}",
+        "slo_decode_chunk": SLO_CHUNK or f"adaptive<={DECODE_CHUNK}",
     }
 
 

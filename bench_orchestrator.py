@@ -195,6 +195,9 @@ def run_clients(transport, port, kind, seconds, clients):
     idle-poll spin (the reference's own rig was 64 locust slaves on
     separate NODES, benchmarking.md:40-58)."""
     per = max(1, clients // CLIENT_PROCS)
+    actual = per * CLIENT_PROCS  # report what actually ran
+    global CLIENTS
+    CLIENTS = actual
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--client",

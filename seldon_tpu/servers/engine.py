@@ -163,23 +163,6 @@ class InferenceEngine:
             functools.partial(self._admit_impl, cfg=self.cfg, mesh=mesh),
             donate_argnums=(1,),
         )
-        # Pallas decode-attention kernel (layer-indexed, pre-write cache,
-        # in-kernel int8 dequant — ops/decode_attention.py). Single-chip
-        # TPU only: pallas doesn't auto-partition under GSPMD. OPT-IN via
-        # SELDON_TPU_DECODE_KERNEL=1; the default is the XLA einsum path,
-        # which measured faster at serving shapes (COVERAGE.md).
-        import os as _os
-
-        from seldon_tpu.ops.decode_attention import _on_tpu
-
-        n_mesh_devices = (
-            1 if mesh is None else int(np.prod(list(mesh.shape.values())))
-        )
-        self._decode_kernel = (
-            _os.environ.get("SELDON_TPU_DECODE_KERNEL", "0") == "1"
-            and n_mesh_devices == 1
-            and _on_tpu()
-        )
         # Chunk-length ladder: exactly the three rungs the policy uses
         # (min / geometric mid / top) — every rung costs a full chunk
         # compile, so no speculative intermediates.
@@ -198,7 +181,6 @@ class InferenceEngine:
                     self._chunk_impl,
                     cfg=self.cfg,
                     n_steps=n,
-                    decode_kernel=self._decode_kernel,
                     mesh=mesh,
                 ),
                 donate_argnums=(1,),
@@ -292,8 +274,7 @@ class InferenceEngine:
         return new_state, first, first_done
 
     @staticmethod
-    def _chunk_impl(params, state, *, cfg, n_steps, decode_kernel=False,
-                    mesh=None):
+    def _chunk_impl(params, state, *, cfg, n_steps, mesh=None):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
         value-level: finished rows stop advancing and emit invalid tokens
@@ -304,7 +285,6 @@ class InferenceEngine:
             run = carry["active"]
             logits, cache = transformer.decode_step(
                 params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
-                decode_kernel=decode_kernel,
             )
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)

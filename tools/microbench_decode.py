@@ -27,13 +27,12 @@ WINDOW = int(os.environ.get("MB_WINDOW", 257))  # prompt 128 + decode 128 + 1
 CHUNK = 64
 
 
-def chunk_impl(params, state, *, cfg, n_steps, kernel=False):
+def chunk_impl(params, state, *, cfg, n_steps):
 
     def step(carry, _):
         run = carry["active"]
         logits, cache = transformer.decode_step(
             params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
-            decode_kernel=kernel,
         )
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
@@ -57,7 +56,7 @@ def chunk_impl(params, state, *, cfg, n_steps, kernel=False):
     return state, toks
 
 
-def bench(weights: str, kv: str, attn: str = "xla", kernel: bool = False) -> float:
+def bench(weights: str, kv: str, attn: str = "xla") -> float:
     cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
                      attn_impl=attn)
     if weights == "int8":
@@ -78,7 +77,7 @@ def bench(weights: str, kv: str, attn: str = "xla", kernel: bool = False) -> flo
         "top_p": jnp.ones((B,), jnp.float32),
         "seeds": jnp.arange(B, dtype=jnp.uint32),
     }
-    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK, kernel=kernel),
+    fn = jax.jit(functools.partial(chunk_impl, cfg=cfg, n_steps=CHUNK),
                  donate_argnums=(1,))
 
     def one(state):
@@ -95,7 +94,7 @@ def bench(weights: str, kv: str, attn: str = "xla", kernel: bool = False) -> flo
     ms_per_step = 1000.0 * dt / CHUNK
     toks_per_s = SLOTS * CHUNK / dt
     print(
-        f"w={weights:5s} kv={kv:5s} attn={attn:5s} krn={int(kernel)} "
+        f"w={weights:5s} kv={kv:5s} attn={attn:5s} "
         f"{ms_per_step:7.3f} ms/step  {toks_per_s:9.0f} tok/s",
         flush=True,
     )
@@ -106,5 +105,4 @@ if __name__ == "__main__":
     combos = sys.argv[1:] or ["int8:bf16", "int8:int8", "bf16:bf16", "bf16:int8"]
     for c in combos:
         parts = c.split(":")
-        kernel = len(parts) > 3 and parts[3] == "krn"
-        bench(*parts[:3] if len(parts) > 2 else parts, kernel=kernel)
+        bench(*parts[:3])
