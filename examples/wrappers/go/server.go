@@ -1,0 +1,120 @@
+// A MODEL unit in pure Go stdlib — implements the REST flavor of the
+// unit protocol (docs/wrappers.md): /predict, /send-feedback, health,
+// metrics, the PREDICTIVE_UNIT_* env contract, and meta echo-through.
+//
+// Reference counterpart: examples/wrappers/go/server.go in the upstream
+// tree (gRPC + tensorflow protos); this one is deliberately
+// dependency-free — the point is how LITTLE a non-python unit needs.
+//
+// Build:  go build -o goserver server.go
+// Run:    PREDICTIVE_UNIT_SERVICE_PORT=9000 ./goserver
+// Try:    curl -s localhost:9000/predict -d '{"data":{"ndarray":[[1,2]]}}'
+package main
+
+import (
+	"encoding/json"
+	"fmt"
+	"log"
+	"net/http"
+	"os"
+	"sync/atomic"
+)
+
+// SeldonMessage — the JSON subset a basic unit needs (ndarray payloads;
+// see seldon_tpu/proto/prediction.proto for the full schema).
+type SeldonMessage struct {
+	Meta map[string]interface{} `json:"meta,omitempty"`
+	Data *DefaultData           `json:"data,omitempty"`
+}
+
+type DefaultData struct {
+	Names   []string        `json:"names,omitempty"`
+	Ndarray [][]float64     `json:"ndarray,omitempty"`
+	Tensor  json.RawMessage `json:"tensor,omitempty"`
+}
+
+type Feedback struct {
+	Request  *SeldonMessage `json:"request,omitempty"`
+	Response *SeldonMessage `json:"response,omitempty"`
+	Reward   float64        `json:"reward,omitempty"`
+}
+
+var (
+	requests int64
+	rewards  int64
+)
+
+// predict: double every value — enough to see the unit in a graph.
+func predict(w http.ResponseWriter, r *http.Request) {
+	var in SeldonMessage
+	if err := json.NewDecoder(r.Body).Decode(&in); err != nil {
+		http.Error(w, fmt.Sprintf(`{"error": %q}`, err.Error()), 400)
+		return
+	}
+	atomic.AddInt64(&requests, 1)
+	out := SeldonMessage{
+		// Echo meta through: the engine threads puid and merges tags.
+		Meta: in.Meta,
+		Data: &DefaultData{Names: []string{"doubled"}},
+	}
+	if out.Meta == nil {
+		out.Meta = map[string]interface{}{}
+	}
+	out.Meta["tags"] = map[string]interface{}{"server": "go-doubler"}
+	if in.Data != nil {
+		for _, row := range in.Data.Ndarray {
+			o := make([]float64, len(row))
+			for i, v := range row {
+				o[i] = v * 2
+			}
+			out.Data.Ndarray = append(out.Data.Ndarray, o)
+		}
+	}
+	w.Header().Set("Content-Type", "application/json")
+	json.NewEncoder(w).Encode(out)
+}
+
+func sendFeedback(w http.ResponseWriter, r *http.Request) {
+	var fb Feedback
+	if err := json.NewDecoder(r.Body).Decode(&fb); err != nil {
+		http.Error(w, fmt.Sprintf(`{"error": %q}`, err.Error()), 400)
+		return
+	}
+	atomic.AddInt64(&rewards, 1)
+	w.Header().Set("Content-Type", "application/json")
+	w.Write([]byte(`{"meta": {}}`))
+}
+
+func health(w http.ResponseWriter, r *http.Request) {
+	w.WriteHeader(200)
+	w.Write([]byte("ok"))
+}
+
+func metrics(w http.ResponseWriter, r *http.Request) {
+	w.Header().Set("Content-Type", "text/plain; version=0.0.4")
+	fmt.Fprintf(w, "# TYPE go_unit_requests_total counter\n")
+	fmt.Fprintf(w, "go_unit_requests_total %d\n", atomic.LoadInt64(&requests))
+	fmt.Fprintf(w, "# TYPE go_unit_feedback_total counter\n")
+	fmt.Fprintf(w, "go_unit_feedback_total %d\n", atomic.LoadInt64(&rewards))
+}
+
+func main() {
+	port := os.Getenv("PREDICTIVE_UNIT_SERVICE_PORT")
+	if port == "" {
+		port = "9000"
+	}
+	// Parameters arrive as JSON [{"name","value","type"}] — log them so
+	// the contract is visible; a real unit would configure itself here.
+	if p := os.Getenv("PREDICTIVE_UNIT_PARAMETERS"); p != "" {
+		log.Printf("parameters: %s", p)
+	}
+	for _, route := range []string{"/predict", "/api/v0.1/predict", "/api/v1.0/predict"} {
+		http.HandleFunc(route, predict)
+	}
+	http.HandleFunc("/send-feedback", sendFeedback)
+	http.HandleFunc("/live", health)
+	http.HandleFunc("/ready", health)
+	http.HandleFunc("/metrics", metrics)
+	log.Printf("go unit %q listening on :%s", os.Getenv("PREDICTIVE_UNIT_ID"), port)
+	log.Fatal(http.ListenAndServe(":"+port, nil))
+}

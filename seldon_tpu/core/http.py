@@ -13,6 +13,15 @@ from aiohttp import web
 from seldon_tpu.core import payloads
 
 PROTO_CONTENT_TYPE = "application/x-protobuf"
+JSON_CONTENT_TYPE = "application/json"
+
+
+def to_json_bytes(msg) -> bytes:
+    """THE client-side JSON encoding of a proto message — one definition
+    of the wire convention (field naming etc.), mirrored server-side by
+    parse_message/reply. Used for foreign-language JSON units and the
+    JSON client transports."""
+    return json.dumps(payloads.message_to_dict(msg)).encode()
 
 
 async def parse_message(request: web.Request, req_cls):

@@ -182,10 +182,21 @@ class InternalClient:
                          identity: Optional[Dict[str, str]] = None):
         session = await self._http_session()
         url = f"http://{ep.service_host}:{ep.service_port}{_REST_PATHS[method]}"
-        headers = {"Content-Type": PROTO_CONTENT_TYPE, **(identity or {})}
+        if ep.content == "json":
+            # Foreign-language units (docs/wrappers.md) speak JSON; our
+            # own units prefer the binary-proto body (zero-copy dense).
+            from seldon_tpu.core.http import JSON_CONTENT_TYPE, to_json_bytes
+
+            body_out = to_json_bytes(request)
+            headers = {"Content-Type": JSON_CONTENT_TYPE,
+                       **(identity or {})}
+        else:
+            body_out = request.SerializeToString()
+            headers = {"Content-Type": PROTO_CONTENT_TYPE,
+                       **(identity or {})}
         async with session.post(
             url,
-            data=request.SerializeToString(),
+            data=body_out,
             headers=tracing.inject_current(headers),
             timeout=self.timeout_s,
         ) as resp:

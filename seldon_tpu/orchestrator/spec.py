@@ -51,21 +51,38 @@ class Endpoint:
     service_host: str = "localhost"
     service_port: int = 9000
     type: EndpointType = EndpointType.GRPC
+    # REST body encoding: "proto" (binary SeldonMessage — the TPU-native
+    # zero-copy default between our own units) or "json" (the lingua
+    # franca for foreign-language units, e.g. examples/wrappers/go —
+    # docs/wrappers.md).
+    content: str = "proto"
 
     @staticmethod
     def from_dict(d: Dict) -> "Endpoint":
+        content = str(d.get("content", "proto")).lower()
+        if content not in ("proto", "json"):
+            # Fail at spec-load time like EndpointType does — a typo here
+            # would otherwise surface as an opaque parse error when the
+            # engine POSTs proto bytes at a JSON-only unit.
+            raise ValueError(
+                f"endpoint content must be 'proto' or 'json', got {content!r}"
+            )
         return Endpoint(
             service_host=d.get("service_host", d.get("serviceHost", "localhost")),
             service_port=int(d.get("service_port", d.get("servicePort", 9000))),
             type=EndpointType(d.get("type", "GRPC")),
+            content=content,
         )
 
     def to_dict(self) -> Dict:
-        return {
+        out = {
             "service_host": self.service_host,
             "service_port": self.service_port,
             "type": self.type.value,
         }
+        if self.content != "proto":
+            out["content"] = self.content
+        return out
 
 
 @dataclasses.dataclass

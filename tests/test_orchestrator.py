@@ -633,3 +633,74 @@ def test_identity_metadata_sent_on_grpc_hop():
     run(go())
     srv.stop(0)
     assert seen == {"m": ("img", "9.9")}, seen
+
+
+def test_engine_calls_json_rest_unit():
+    """A foreign-language JSON-only REST unit (the docs/wrappers.md
+    contract — mirrored on examples/wrappers/go/server.go's behavior)
+    serves inside a graph when its endpoint declares content: json."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class GoLikeUnit(BaseHTTPRequestHandler):
+        def do_POST(self):
+            assert self.headers["Content-Type"] == "application/json"
+            body = _json.loads(
+                self.rfile.read(int(self.headers["Content-Length"]))
+            )
+            rows = [[v * 2 for v in row]
+                    for row in body.get("data", {}).get("ndarray", [])]
+            out = {
+                "meta": {**body.get("meta", {}),
+                         "tags": {"server": "go-doubler"}},
+                "data": {"names": ["doubled"], "ndarray": rows},
+            }
+            payload = _json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), GoLikeUnit)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        eng = PredictorEngine(spec_from({
+            "name": "p",
+            "graph": {
+                "name": "gounit",
+                "type": "MODEL",
+                "image": "go-doubler:1",
+                "endpoint": {
+                    "service_host": "127.0.0.1",
+                    "service_port": port,
+                    "type": "REST",
+                    "content": "json",
+                },
+            },
+        }))
+        msg = payloads.build_message(
+            np.array([[1.0, 2.5]]), names=["a", "b"], kind="ndarray"
+        )
+
+        async def run():
+            # Single loop for predict AND close: the client session's
+            # transports belong to this loop.
+            out = await eng.predict(msg)
+            await eng.close()
+            return out
+
+        out = asyncio.run(run())
+        arr = payloads.get_data_from_message(out)
+        np.testing.assert_allclose(np.asarray(arr, float), [[2.0, 5.0]])
+        assert "gounit" in out.meta.requestPath
+        assert out.meta.puid
+    finally:
+        srv.shutdown()
+        t.join(timeout=5)
