@@ -37,6 +37,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _proc_sink():
+    """SELDON_TPU_LOCALSTORE_DEBUG=1 lets spawned pods inherit stdio
+    (debugging a pod that never becomes ready); default devnull."""
+    if os.environ.get("SELDON_TPU_LOCALSTORE_DEBUG") == "1":
+        return None
+    return subprocess.DEVNULL
+
+
 def _port_open(port: int) -> bool:
     with socket.socket() as s:
         s.settimeout(0.2)
@@ -194,7 +202,7 @@ class LocalProcessStore:
             env["PREDICTIVE_UNIT_SERVICE_PORT"] = str(port)
             pod.procs.append(subprocess.Popen(
                 cmd, env={**base_env, **env}, cwd=self.repo_root,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                stdout=_proc_sink(), stderr=_proc_sink(),
             ))
 
         if engine_container is not None:
@@ -227,7 +235,7 @@ class LocalProcessStore:
             ]
             pod.procs.append(subprocess.Popen(
                 cmd, env={**base_env, **env}, cwd=self.repo_root,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                stdout=_proc_sink(), stderr=_proc_sink(),
             ))
         self.pods[name] = pod
         logger.info("launched workload %s: ports=%s", name, pod.ports)

@@ -24,7 +24,11 @@ from seldon_tpu.proto import prediction_pb2 as pb
 
 logger = logging.getLogger(__name__)
 
-from seldon_tpu.core.http import PROTO_CONTENT_TYPE  # noqa: F401 (shared constant)
+from seldon_tpu.core.http import (  # noqa: F401 (shared constants)
+    JSON_CONTENT_TYPE,
+    PROTO_CONTENT_TYPE,
+    to_json_bytes,
+)
 
 # engine-side call name -> (service, rpc) — typed per-unit stubs mirroring
 # the reference (InternalPredictionService.java:269-306).
@@ -185,8 +189,6 @@ class InternalClient:
         if ep.content == "json":
             # Foreign-language units (docs/wrappers.md) speak JSON; our
             # own units prefer the binary-proto body (zero-copy dense).
-            from seldon_tpu.core.http import JSON_CONTENT_TYPE, to_json_bytes
-
             body_out = to_json_bytes(request)
             headers = {"Content-Type": JSON_CONTENT_TYPE,
                        **(identity or {})}
@@ -207,6 +209,16 @@ class InternalClient:
                     resp.status,
                 )
             ctype = resp.headers.get("Content-Type", "")
-            if ctype.startswith(PROTO_CONTENT_TYPE):
-                return response_cls.FromString(body)
-            return payloads.dict_to_message(body.decode(), response_cls)
+            try:
+                if ctype.startswith(PROTO_CONTENT_TYPE):
+                    return response_cls.FromString(body)
+                return payloads.dict_to_message(body.decode(), response_cls)
+            except Exception as e:
+                # A 200 with an unparseable body (buggy foreign unit) is
+                # a unit failure, not an engine crash — callers promise
+                # ENGINE_UNIT_FAILURE semantics (docs/wrappers.md §2).
+                raise UnitCallError(
+                    ep.service_host, method,
+                    f"unparseable {ctype or 'response'} body: {e}",
+                    resp.status,
+                ) from e

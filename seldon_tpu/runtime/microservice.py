@@ -119,6 +119,19 @@ async def serve(
 
 
 def main(argv=None):
+    # An explicit JAX_PLATFORMS env pin must WIN: some images ship a
+    # sitecustomize that re-points jax at an accelerator plugin at
+    # interpreter start, overriding the env — a CPU-pinned unit
+    # subprocess (LocalProcessStore pods, CI) would then hang on an
+    # unreachable accelerator the moment load() touches jax.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # pragma: no cover - jax always importable here
+            pass
     parser = argparse.ArgumentParser(prog="seldon-tpu-microservice")
     parser.add_argument("interface_name", help="user class (Module.Class)")
     parser.add_argument(

@@ -238,3 +238,27 @@ def test_abtest_mab_example_routes_and_learns(tmp_path):
         assert routed, "router never routed to a model"
     finally:
         store.close()
+
+
+@pytest.mark.e2e
+def test_case_study_mab_converges(tmp_path):
+    """The runnable MAB case study (examples/case_study_mab.py — the
+    reference's credit_card_default notebook counterpart): bandit must
+    route the majority of traffic to the measurably better arm."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "case_study_mab",
+        os.path.join(REPO, "examples", "case_study_mab.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    good_dir, weak_dir, acc_good = mod.train_arms(str(tmp_path))
+    assert acc_good > 0.8
+    store, port = mod.deploy(good_dir, weak_dir)
+    try:
+        served, acc = mod.run_stream(port, n=200)
+        share = served["model-good"] / sum(served.values())
+        assert share > 0.5, served
+    finally:
+        store.close()

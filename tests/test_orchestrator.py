@@ -704,3 +704,55 @@ def test_engine_calls_json_rest_unit():
     finally:
         srv.shutdown()
         t.join(timeout=5)
+
+
+def test_json_rest_unit_malformed_body_is_unit_failure():
+    """A 200 with an unparseable body from a foreign unit must surface
+    as UnitCallError (-> ENGINE_UNIT_FAILURE), not an engine crash."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class BrokenUnit(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers["Content-Length"]))
+            payload = b'{"data": {"ndarray": [[1.0'  # truncated JSON
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), BrokenUnit)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        eng = PredictorEngine(spec_from({
+            "name": "p",
+            "graph": {
+                "name": "broken", "type": "MODEL", "image": "broken:1",
+                "endpoint": {"service_host": "127.0.0.1",
+                             "service_port": port, "type": "REST",
+                             "content": "json"},
+            },
+        }))
+        msg = payloads.build_message(np.array([[1.0]]), kind="ndarray")
+
+        async def run():
+            from seldon_tpu.orchestrator.client import UnitCallError
+
+            try:
+                await eng.predict(msg)
+                raise AssertionError("expected UnitCallError")
+            except UnitCallError as e:
+                assert "unparseable" in str(e)
+            finally:
+                await eng.close()
+
+        asyncio.run(run())
+    finally:
+        srv.shutdown()
+        t.join(timeout=5)
