@@ -176,9 +176,15 @@ def _measure_slo(params, cfg, sp) -> dict:
     best_p50 = float("inf")
     rate = 5.0
     step_up = 1.6
-    # Exponential ladder up, then one bisection refinement pass.
+    # Exponential ladder up, then one bisection refinement pass. A rung
+    # failure gets ONE retry before it ends the climb: on a tunneled rig
+    # a single RT spike poisons a whole 10 s window, and a spurious
+    # first-rung failure would otherwise bisect down to a nonsense
+    # near-zero answer.
     while rate <= 4.0 * BASELINE_REQ_S_PER_CHIP:
         p50 = run_rate(rate)
+        if not p50 < target:
+            p50 = run_rate(rate)
         if p50 < target:
             best, best_p50 = rate, p50
             rate *= step_up
@@ -186,6 +192,8 @@ def _measure_slo(params, cfg, sp) -> dict:
             break
     lo, hi = best, rate
     for _ in range(3):
+        if best == 0.0:
+            break  # nothing held: report 0 honestly, don't bisect air
         mid = (lo + hi) / 2.0
         if mid <= best:
             break

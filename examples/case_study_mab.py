@@ -120,23 +120,29 @@ def deploy(good_dir, weak_dir, epsilon=0.1):
         }]},
     }
     store = LocalProcessStore(repo_root=REPO)
-    rec = Reconciler(store, istio_enabled=False)
-    sdep = SeldonDeployment.from_dict(cr)
-    # Four cold jax processes share the host; on a 1-core box startup
-    # alone can take minutes.
-    deadline = time.time() + 420
-    while time.time() < deadline:
-        status = rec.reconcile(sdep)
-        if status.state == "Available":
-            break
-        if status.state == "Failed":
-            raise RuntimeError(status)
-        store.wait_ready(30)
-    else:
-        raise RuntimeError("never became Available")
-    dep = next(m["metadata"]["name"]
-               for m in store.list("Deployment", "default"))
-    return store, store.engine_port(dep)
+    try:
+        rec = Reconciler(store, istio_enabled=False)
+        sdep = SeldonDeployment.from_dict(cr)
+        # Four cold jax processes share the host; on a 1-core box
+        # startup alone can take minutes.
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            status = rec.reconcile(sdep)
+            if status.state == "Available":
+                break
+            if status.state == "Failed":
+                raise RuntimeError(status)
+            store.wait_ready(30)
+        else:
+            raise RuntimeError("never became Available")
+        dep = next(m["metadata"]["name"]
+                   for m in store.list("Deployment", "default"))
+        return store, store.engine_port(dep)
+    except BaseException:
+        # Failure paths must not strand the spawned engine/unit
+        # subprocesses — the caller never gets a handle to close.
+        store.close()
+        raise
 
 
 def _post(port, path, body, timeout=90):

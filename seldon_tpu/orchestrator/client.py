@@ -220,5 +220,4 @@ class InternalClient:
                 raise UnitCallError(
                     ep.service_host, method,
                     f"unparseable {ctype or 'response'} body: {e}",
-                    resp.status,
                 ) from e

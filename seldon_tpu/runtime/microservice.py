@@ -131,7 +131,11 @@ def main(argv=None):
 
             jax.config.update("jax_platforms", plat)
         except Exception:  # pragma: no cover - jax always importable here
-            pass
+            logging.getLogger(__name__).warning(
+                "could not re-pin jax_platforms to %r — a sitecustomize "
+                "override may leave this unit on an unreachable backend",
+                plat, exc_info=True,
+            )
     parser = argparse.ArgumentParser(prog="seldon-tpu-microservice")
     parser.add_argument("interface_name", help="user class (Module.Class)")
     parser.add_argument(
