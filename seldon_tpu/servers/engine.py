@@ -95,6 +95,11 @@ class EngineStats:
         self.tokens_out = 0
         self.ttft_sum = 0.0
         self.ttft_count = 0
+        # Scheduler observability: decode dispatches and total steps
+        # dispatched — their ratio is the effective (adaptive) chunk
+        # length, the knob the occupancy policy is turning.
+        self.decode_dispatches = 0
+        self.decode_steps = 0
 
     def snapshot(self) -> Dict[str, float]:
         with self.lock:
@@ -107,6 +112,8 @@ class EngineStats:
                     if self.ttft_count
                     else 0.0
                 ),
+                "decode_dispatches": self.decode_dispatches,
+                "decode_steps": self.decode_steps,
             }
 
 
@@ -762,6 +769,9 @@ class InferenceEngine:
             self._state, toks, valid, active_after = self._jit_chunks[n](
                 self.params, self._state
             )
+            with self.stats.lock:
+                self.stats.decode_dispatches += 1
+                self.stats.decode_steps += n
             self._recycle_budget_spent(roster, n)
             # Start the host copies NOW: the fetcher's device_get then
             # finds data already in flight, so boundary fetches overlap
@@ -817,6 +827,9 @@ class InferenceEngine:
                         self._jit_chunks[n](self.params, self._state)
                     )
                     chunk_handles = (toks, valid, active_after)
+                    with self.stats.lock:
+                        self.stats.decode_dispatches += 1
+                        self.stats.decode_steps += n
                     self._recycle_budget_spent(roster, n)
                 else:
                     chunk_handles = None

@@ -344,6 +344,14 @@ class JAXServer(SeldonComponent):
              "value": float(s["tokens_out"])},
             {"type": "GAUGE", "key": "jaxserver_completed",
              "value": float(s["completed"])},
+            {"type": "GAUGE", "key": "jaxserver_slots_busy",
+             "value": float(sum(
+                 1 for r in self.engine._slots if r is not None
+             ))},
+            {"type": "GAUGE", "key": "jaxserver_decode_dispatches",
+             "value": float(s["decode_dispatches"])},
+            {"type": "GAUGE", "key": "jaxserver_decode_steps",
+             "value": float(s["decode_steps"])},
         ]
 
     def tags(self) -> Dict:

@@ -30,6 +30,9 @@ EXPORTED = {
     "jaxserver_mean_ttft_ms",
     "jaxserver_tokens_out",
     "jaxserver_completed",
+    "jaxserver_slots_busy",
+    "jaxserver_decode_dispatches",
+    "jaxserver_decode_steps",
 }
 # Series emitted by external exporters we integrate with (kube-state-metrics).
 EXTERNAL = {"kube_statefulset_status_replicas_ready", "kube_statefulset_replicas"}

@@ -142,7 +142,13 @@ def test_jaxserver_metrics_tags(server):
     server.generate({"prompt": "x", "max_new_tokens": 2})
     m = server.metrics()
     keys = {d["key"] for d in m}
-    assert "jaxserver_mean_ttft_ms" in keys
+    assert {"jaxserver_mean_ttft_ms", "jaxserver_slots_busy",
+            "jaxserver_decode_dispatches",
+            "jaxserver_decode_steps"} <= keys
+    stats = {d["key"]: d["value"] for d in m}
+    assert stats["jaxserver_decode_dispatches"] >= 1
+    assert stats["jaxserver_decode_steps"] >= stats[
+        "jaxserver_decode_dispatches"]
     assert server.tags()["server"] == "jaxserver"
 
 

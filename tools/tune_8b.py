@@ -1,0 +1,79 @@
+"""Quick llama3-8b engine sweep: (slots, max_admit, decode_chunk) →
+req/s on a short saturation wave. Run alone on the real chip.
+
+    python -m tools.tune_8b "96:8:64" "160:8:64" "160:16:64" ...
+
+Each config runs N_REQ = 2×slots requests (prefill 128 + decode 128)
+through a fresh engine and prints one line. ~4-6 min per config (8B
+compile + init dominate the first; params are built once)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+from seldon_tpu.models import get_config
+from seldon_tpu.models.quantize import init_params_int8
+from seldon_tpu.models.sampling import SamplingParams
+from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+PROMPT, NEW = 128, 128
+
+
+def run(params, cfg, slots, max_admit, chunk):
+    ecfg = EngineConfig(
+        max_slots=slots,
+        max_seq_len=PROMPT + NEW + 1,
+        prompt_buckets=(PROMPT,),
+        max_admit=max_admit,
+        decode_chunk=chunk,
+    )
+    eng = InferenceEngine(params, cfg, ecfg)
+    eng.warmup()
+    eng.start()
+    rng = np.random.default_rng(0)
+    n_req = 2 * slots
+    prompts = rng.integers(3, cfg.vocab_size, size=(n_req, PROMPT))
+
+    def sp(i):
+        return SamplingParams(temperature=0.7, top_k=0, top_p=1.0,
+                              max_new_tokens=NEW, seed=i)
+
+    # settle
+    for q in [eng.submit(prompts[i].tolist(), sp(i)) for i in range(8)]:
+        while q.get() is not None:
+            pass
+    t0 = time.perf_counter()
+    qs = [eng.submit(prompts[i].tolist(), sp(i)) for i in range(n_req)]
+    toks = 0
+    for q in qs:
+        while (item := q.get()) is not None:
+            if "error" in item:
+                raise RuntimeError(item["error"])
+            toks += len(item.get("tokens", []))
+    dt = time.perf_counter() - t0
+    eng.stop()
+    print(
+        f"slots={slots:4d} admit={max_admit:3d} chunk={chunk:3d}  "
+        f"{n_req/dt:7.2f} req/s  {toks/dt:8.0f} tok/s  "
+        f"vs_north_star={n_req/dt/125.0:.3f}",
+        flush=True,
+    )
+
+
+def main():
+    combos = []
+    for arg in sys.argv[1:] or ["96:8:64", "160:8:64", "160:16:64"]:
+        s, a, c = (int(x) for x in arg.split(":"))
+        combos.append((s, a, c))
+    cfg = get_config("llama3-8b", kv_cache_dtype="int8", weight_dtype="int8")
+    params = init_params_int8(cfg, jax.random.key(0))
+    for s, a, c in combos:
+        run(params, cfg, s, a, c)
+
+
+if __name__ == "__main__":
+    main()
