@@ -284,15 +284,22 @@ def construct_response(
             else:
                 _set_value(msg.meta.tags[k], v)
     if metrics:
-        for m in metrics:
-            metric = msg.meta.metrics.add()
-            metric.key = m.get("key", "")
-            metric.value = float(m.get("value", 0.0))
-            mtype = m.get("type", "COUNTER")
-            metric.type = pb.Metric.MetricType.Value(mtype)
-            for tk, tv in (m.get("tags") or {}).items():
-                metric.tags[tk] = str(tv)
+        add_metric_dicts(msg.meta.metrics, metrics)
     return msg
+
+
+def add_metric_dicts(repeated_metrics, dicts) -> None:
+    """Append metric DICTS ({key,value,type,tags}) onto a repeated
+    pb.Metric field — the one definition of the dict->Metric wire
+    conversion (used by construct_response and the wrapper's generate
+    metrics absorption)."""
+    for m in dicts:
+        metric = repeated_metrics.add()
+        metric.key = m.get("key", "")
+        metric.value = float(m.get("value", 0.0))
+        metric.type = pb.Metric.MetricType.Value(m.get("type", "COUNTER"))
+        for tk, tv in (m.get("tags") or {}).items():
+            metric.tags[tk] = str(tv)
 
 
 def _set_value(value: Value, py: Any) -> None:

@@ -39,6 +39,26 @@ from seldon_tpu.runtime.user_model import SeldonNotImplementedError
 logger = logging.getLogger(__name__)
 
 
+def _absorb_user_metrics(metrics: ServerMetrics, user_obj) -> None:
+    """Pull the unit's validated custom metrics() into the registry.
+    The predict path does this through response meta
+    (construct_response); generate responses carry no meta.metrics, so
+    TextGen-only units would otherwise never surface their gauges on
+    /metrics. Uses the same validation (client_custom_metrics) and
+    dict->Metric conversion (payloads.add_metric_dicts) as predict."""
+    from seldon_tpu.runtime.user_model import client_custom_metrics
+
+    try:
+        dicts = client_custom_metrics(user_obj)
+        if not dicts:
+            return
+        meta = pb.Meta()
+        payloads.add_metric_dicts(meta.metrics, dicts)
+        metrics.record_custom(meta.metrics)
+    except Exception:  # metrics must never fail a served request
+        logger.exception("user metrics absorption failed")
+
+
 
 def _unit_name() -> str:
     import os
@@ -167,6 +187,10 @@ def build_rest_app(
                 SeldonMicroserviceException(str(e), 500).to_dict(), status=500
             )
         request.app["metrics"].observe("generate", "rest", time.perf_counter() - t0, None)
+        await loop.run_in_executor(
+            request.app["executor"], _absorb_user_metrics,
+            request.app["metrics"], request.app["user_obj"],
+        )
         if encoding == "proto":
             return web.Response(body=resp.SerializeToString(), content_type=PROTO_CONTENT_TYPE)
         return web.json_response(payloads.message_to_dict(resp))
@@ -249,6 +273,8 @@ class _UnitServicer:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
             return None
         self._metrics.observe(name, "grpc", time.perf_counter() - t0, resp)
+        if name == "generate":
+            _absorb_user_metrics(self._metrics, self._user)
         return resp
 
     def Predict(self, request, context):
@@ -297,6 +323,7 @@ class _UnitServicer:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
             return
         self._metrics.observe("generate-stream", "grpc", time.perf_counter() - t0, None)
+        _absorb_user_metrics(self._metrics, self._user)
 
 
 def build_grpc_server(
