@@ -406,20 +406,36 @@ def _mlp_res(x, bp, cfg, act_spec):
 
 
 def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
-                        act_spec=None):
+                        act_spec=None, ring_mesh=None):
     """Layer scan for PREFILL: attention runs over the fresh k/v only
     (every serving prefill starts at position 0, so the fresh tokens ARE
     the whole visible window — the cache is never read) and each layer's
     rope'd k/v come back as scan ys, stacked [L, B, Hkv, S, Dh], exactly
     the head-major cache layout. The caller builds/updates the cache from
-    them in ONE operation — no per-layer cache traffic at all. Returns
+    them in ONE operation — no per-layer cache traffic at all.
+
+    `ring_mesh` (with cfg.attn_impl == "ring") runs the attention as
+    CONTEXT-PARALLEL ring attention over the 'sp' mesh axis — long
+    prompts prefill with the sequence sharded across devices, k/v blocks
+    rotating over ICI (parallel/ring_attention.py). The returned k/v ys
+    are full arrays; GSPMD gathers the sp shards when the caller
+    scatters them into the (T-unsharded) decode cache. Returns
     (x, {"k","v"} stacked bf16, aux)."""
 
     def body(carry, bp):
         h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
         B, S = q.shape[0], q.shape[1]
-        if cfg.attn_impl == "flash" and S > 1:
+        if ring_mesh is not None and cfg.attn_impl == "ring" and S > 1:
+            from seldon_tpu.parallel.ring_attention import ring_attention
+
+            G = cfg.q_per_kv
+            out = ring_attention(
+                q, jnp.repeat(k, G, axis=2), jnp.repeat(v, G, axis=2),
+                ring_mesh, axis="sp", causal=True,
+            )
+            attn = out.reshape(B, S, cfg.n_heads * cfg.head_dim)
+        elif cfg.attn_impl == "flash" and S > 1:
             from seldon_tpu.ops.flash_attention import flash_attention
 
             Dh = cfg.head_dim
@@ -586,12 +602,27 @@ def prefill(
     prompt_lens: jnp.ndarray,  # [B] true lengths
     cache: Cache,
     cfg: ModelConfig,
+    ring_mesh=None,
 ) -> Tuple[jnp.ndarray, Cache]:
     """Run prompts through the model, filling cache slots [0, S).
     Returns (next-token logits [B, V] taken at each row's last real token,
-    updated cache)."""
+    updated cache). `ring_mesh` + cfg.attn_impl=="ring": context-parallel
+    prefill — the prompt's sequence axis shards over 'sp' and attention
+    runs as a ring (long-prompt admissions scale across the slice; the
+    decode cache stays T-unsharded, GSPMD gathers the shards at the
+    cache write)."""
     B, S = tokens.shape
     x = _embed_rows(params, tokens, _dtype(cfg))
+    use_ring = ring_mesh is not None and cfg.attn_impl == "ring" and S > 1
+    if use_ring:
+        # Pin the activation sequence axis to 'sp' so the per-layer qkv
+        # projections and MLP also run sequence-sharded, not just the
+        # ring attention itself.
+        x = jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(
+                ring_mesh, P(None, "sp", None)
+            )
+        )
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     inv_freq = rope_frequencies(cfg)
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None].repeat(B, 0)
@@ -599,7 +630,8 @@ def prefill(
     # Attention never reads `cache` — prefill starts at position 0, so the
     # fresh tokens are the entire visible window (_run_blocks_prefill).
     # The stacked ys land in the cache in one update per array.
-    x, kv, _ = _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask)
+    x, kv, _ = _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
+                                   ring_mesh=ring_mesh if use_ring else None)
     if cfg.kv_cache_dtype == "int8":
         kq, ks = _quantize_kv(kv["k"])
         vq, vs = _quantize_kv(kv["v"])

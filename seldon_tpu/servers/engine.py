@@ -166,8 +166,20 @@ class InferenceEngine:
         ma = max(1, min(self.ecfg.max_admit, B))
         self._max_admit = 1 << (ma.bit_length() - 1)
 
+        # Context-parallel prefill: with attn_impl=="ring" and a mesh
+        # carrying a real 'sp' axis, admissions prefill with the prompt
+        # sequence sharded across the ring (long-prompt scaling;
+        # transformer.prefill). Decode is untouched (T-unsharded cache).
+        self._ring_mesh = (
+            mesh
+        ) if (
+            mesh is not None
+            and self.cfg.attn_impl == "ring"
+            and dict(mesh.shape).get("sp", 1) > 1
+        ) else None
         self._jit_admit = jax.jit(
-            functools.partial(self._admit_impl, cfg=self.cfg, mesh=mesh),
+            functools.partial(self._admit_impl, cfg=self.cfg, mesh=mesh,
+                              ring_mesh=self._ring_mesh),
             donate_argnums=(1,),
         )
         # Chunk-length ladder: exactly the three rungs the policy uses
@@ -229,7 +241,7 @@ class InferenceEngine:
     @staticmethod
     def _admit_impl(
         params, state, toks, plens, seeds, temps, top_ks, top_ps,
-        max_news, slots, *, cfg, mesh=None,
+        max_news, slots, *, cfg, mesh=None, ring_mesh=None,
     ):
         """Fused admission: prefill [G, Sb], scatter into cache slots, sample
         first tokens, arm slot state. One dispatch, no host sync.
@@ -241,7 +253,12 @@ class InferenceEngine:
         so the duplicate scatter writes are well-defined."""
         G, Sb = toks.shape
         sub = transformer.init_cache(cfg, G, Sb)
-        logits, sub = transformer.prefill(params, toks, plens, sub, cfg)
+        if ring_mesh is not None:
+            sp = dict(ring_mesh.shape).get("sp", 1)
+            if Sb % sp != 0:  # static per-bucket decision
+                ring_mesh = None
+        logits, sub = transformer.prefill(params, toks, plens, sub, cfg,
+                                          ring_mesh=ring_mesh)
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p)
         )(seeds, plens)

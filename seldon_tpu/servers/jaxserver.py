@@ -49,6 +49,7 @@ class JAXServer(SeldonComponent):
         init_seed: int = 0,
         warmup: int = 0,
         weight_dtype: str = "",
+        mesh_sp: int = 0,
     ):
         self.model_uri = model_uri
         self.preset = preset
@@ -56,6 +57,10 @@ class JAXServer(SeldonComponent):
         self.max_seq_len = int(max_seq_len)
         self.init_seed = int(init_seed)
         self.warmup = int(warmup)
+        # Context-parallel axis width for long-prompt serving: with
+        # attn_impl=="ring", admissions prefill with the sequence
+        # sharded over 'sp' (ring attention); 0 = no sp axis.
+        self.mesh_sp = int(mesh_sp)
         # Overrides the checkpoint config's weight_dtype: HF checkpoints
         # are always bf16 on disk, so serving them int8 (the llama3-8b-
         # on-one-16GB-chip config) is selected HERE (or via the
@@ -207,11 +212,20 @@ class JAXServer(SeldonComponent):
             )
 
     def _mesh_for(self, cfg):
+        import math
+
         import jax
 
         from seldon_tpu.parallel import MeshPlan, make_mesh
 
-        return make_mesh(MeshPlan.auto(len(jax.devices()), cfg))
+        n = len(jax.devices())
+        if self.mesh_sp > 1 and cfg.attn_impl == "ring" and n % self.mesh_sp == 0:
+            rem = n // self.mesh_sp
+            tp = math.gcd(rem, cfg.n_kv_heads)
+            return make_mesh(MeshPlan(
+                sp=self.mesh_sp, tp=tp, dp=rem // tp
+            ))
+        return make_mesh(MeshPlan.auto(n, cfg))
 
     def _ensure_loaded(self):
         if not self._loaded:

@@ -366,3 +366,51 @@ def test_engine_adaptive_chunk_policy():
         decode_chunk=32, adaptive_chunk=False))
     assert fixed._chunk_sizes == (32,)
     assert fixed._pick_chunk() == 32
+
+
+def test_engine_ring_prefill_matches_xla():
+    """Context-parallel (ring) prefill in the serving engine: greedy
+    completions over an sp=4 mesh must match the plain XLA-attention
+    engine bit-for-bit (ring attention is exact, not approximate) —
+    SURVEY §5.7 long-context serving."""
+    import dataclasses
+
+    import jax
+
+    from seldon_tpu.models import init_params
+    from seldon_tpu.parallel import MeshPlan, make_mesh
+    from seldon_tpu.parallel import sharding as shd
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    prompts = [[7, 8, 9, 10, 11], [3, 4, 5]]
+
+    def complete(cfg_used, mesh):
+        if mesh is not None:
+            shardings = shd.named_shardings(mesh, shd.param_pspecs(cfg_used))
+            p = jax.device_put(params, shardings)
+        else:
+            p = params
+        eng = InferenceEngine(
+            p, cfg_used,
+            EngineConfig(max_slots=2, max_seq_len=48, prompt_buckets=(8,),
+                         max_admit=2, decode_chunk=4),
+            mesh=mesh,
+        )
+        eng.start()
+        try:
+            return [
+                eng.generate_blocking(
+                    pr, SamplingParams(temperature=0.0, max_new_tokens=6)
+                )["token_ids"]
+                for pr in prompts
+            ]
+        finally:
+            eng.stop()
+
+    base = complete(cfg, None)
+
+    ring_cfg = dataclasses.replace(cfg, attn_impl="ring")
+    mesh = make_mesh(MeshPlan(sp=4, tp=2))
+    ring = complete(ring_cfg, mesh)
+    assert ring == base, (ring, base)
