@@ -57,7 +57,13 @@ BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
 SLO_TTFT_MS = 100.0  # BASELINE.md north star: p50 TTFT < 100 ms
-SLO_ENABLED = os.environ.get("BENCH_SLO", "1") == "1"
+# SLO search defaults ON for the bench-1b proxy (where the TTFT claim
+# is meaningful per-chip) and OFF for the 8B single-chip run — there
+# the search costs ~15 min and, on a tunneled rig, measures the rig's
+# round trip; the 8B line already reports saturation p50/p99 TTFT.
+SLO_ENABLED = os.environ.get(
+    "BENCH_SLO", "1" if PRESET == "bench-1b" else "0"
+) == "1"
 # The SLO search runs the SAME engine config as the throughput leg:
 # occupancy-adaptive chunking (EngineConfig.adaptive_chunk) picks short
 # chunks in the under-capacity latency regime and the full decode_chunk
