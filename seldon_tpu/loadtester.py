@@ -25,14 +25,17 @@ from typing import List, Optional
 import numpy as np
 
 
-async def run_rest(url: str, payload: bytes, clients: int, seconds: float,
-                   path: str = "/api/v0.1/predictions"):
+async def _closed_loop(url_path: str, body: bytes, clients: int,
+                       seconds: float, on_response=None):
+    """Shared closed-loop HTTP driver: N workers hammer one endpoint
+    until the deadline. `on_response` (async, gets the aiohttp response)
+    does transport-specific accounting; non-200s and exceptions count
+    as errors and are excluded from latency."""
     import aiohttp
 
     stop_at = time.perf_counter() + seconds
     latencies: List[float] = []
     errors = [0]
-    full = url.rstrip("/") + path
     headers = {"Content-Type": "application/json"}
 
     async def worker(session):
@@ -40,12 +43,16 @@ async def run_rest(url: str, payload: bytes, clients: int, seconds: float,
         while time.perf_counter() < stop_at:
             t0 = time.perf_counter()
             try:
-                async with session.post(full, data=payload,
+                async with session.post(url_path, data=body,
                                         headers=headers) as r:
-                    await r.read()
                     if r.status != 200:
+                        await r.read()
                         errors[0] += 1
                         continue
+                    if on_response is not None:
+                        await on_response(r)
+                    else:
+                        await r.read()
             except Exception:
                 errors[0] += 1
                 continue
@@ -61,6 +68,12 @@ async def run_rest(url: str, payload: bytes, clients: int, seconds: float,
         )
         dt = time.perf_counter() - t0
     return sum(counts), dt, latencies, errors[0]
+
+
+async def run_rest(url: str, payload: bytes, clients: int, seconds: float,
+                   path: str = "/api/v0.1/predictions"):
+    return await _closed_loop(url.rstrip("/") + path, payload, clients,
+                              seconds)
 
 
 async def run_grpc(target: str, payload_rows, clients: int, seconds: float):
@@ -97,8 +110,34 @@ async def run_grpc(target: str, payload_rows, clients: int, seconds: float):
     return sum(counts), dt, latencies, errors[0]
 
 
+async def run_generate(url: str, clients: int, seconds: float,
+                       prompt: str = "benchmark prompt",
+                       max_new_tokens: int = 32,
+                       temperature: float = 0.0):
+    """LLM serving load: closed-loop /generate clients. Latency here is
+    full completion time; tokens/s is the serving-throughput number (the
+    engine's own TTFT gauges cover time-to-first-token). Greedy by
+    default so completion lengths — and therefore tokens/s — are
+    reproducible across runs."""
+    tokens = [0]
+
+    async def count_tokens(r):
+        out = await r.json()
+        tokens[0] += int(out.get("completion_tokens", 0))
+
+    body = json.dumps({
+        "prompt": prompt, "max_new_tokens": max_new_tokens,
+        "temperature": temperature,
+    }).encode()
+    total, dt, lats, errors = await _closed_loop(
+        url.rstrip("/") + "/generate", body, clients, seconds,
+        on_response=count_tokens,
+    )
+    return total, dt, lats, errors, tokens[0]
+
+
 def report(transport: str, total: int, dt: float, latencies, errors: int,
-           clients: int) -> dict:
+           clients: int, extra: Optional[dict] = None) -> dict:
     lats = np.asarray(latencies) * 1000.0 if latencies else np.zeros(1)
     out = {
         "metric": f"loadtest_{transport}_req_per_s",
@@ -110,6 +149,7 @@ def report(transport: str, total: int, dt: float, latencies, errors: int,
             "p50_ms": round(float(np.percentile(lats, 50)), 2),
             "p90_ms": round(float(np.percentile(lats, 90)), 2),
             "p99_ms": round(float(np.percentile(lats, 99)), 2),
+            **(extra or {}),
         },
     }
     print(json.dumps(out))
@@ -121,15 +161,28 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("url", help="engine base URL (http://host:port)")
     parser.add_argument("--clients", type=int, default=64)
     parser.add_argument("--seconds", type=float, default=30.0)
-    parser.add_argument("--transport", choices=["rest", "grpc"],
+    parser.add_argument("--transport", choices=["rest", "grpc", "generate"],
                         default="rest")
     parser.add_argument("--payload",
                         default='{"data": {"ndarray": [[1.0, 2.0]]}}')
     parser.add_argument("--grpc-host", default="",
                         help="host:port for --transport grpc")
     parser.add_argument("--path", default="/api/v0.1/predictions")
+    parser.add_argument("--prompt", default="benchmark prompt")
+    parser.add_argument("--max-new-tokens", type=int, default=32)
+    parser.add_argument("--temperature", type=float, default=0.0)
     args = parser.parse_args(argv)
 
+    if args.transport == "generate":
+        total, dt, lats, errors, toks = asyncio.run(
+            run_generate(args.url, args.clients, args.seconds,
+                         args.prompt, args.max_new_tokens,
+                         args.temperature)
+        )
+        report("generate", total, dt, lats, errors, args.clients,
+               extra={"completion_tokens": toks,
+                      "tokens_per_s": round(toks / dt, 1) if dt else 0.0})
+        return
     if args.transport == "rest":
         total, dt, lats, errors = asyncio.run(
             run_rest(args.url, args.payload.encode(), args.clients,
