@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 # Env overrides are for local smoke-testing only (e.g. BENCH_PRESET=tiny
@@ -71,6 +72,178 @@ SLO_ENABLED = os.environ.get(
 # chunk-4-for-SLO mode switch is gone. BENCH_SLO_CHUNK pins a fixed
 # chunk for A/B comparison.
 SLO_CHUNK = int(os.environ.get("BENCH_SLO_CHUNK", 0))  # 0 = adaptive
+
+
+# ---------------------------------------------------------------------------
+# Outage-proofing (round-5). The bench rig's TPU is tunneled and the tunnel
+# FLAKES: `jax.devices()` can HANG (not error) for hours, and round 4 lost its
+# entire perf record to one bring-up failure at minute zero. So the measurement
+# now runs in a supervised CHILD process:
+#   - the parent first polls backend bring-up in killable probe subprocesses
+#     (a hang is indistinguishable from slow without a kill), with backoff,
+#     for up to BENCH_BACKEND_WAIT seconds;
+#   - the child prints a full metric JSON line after EVERY completed phase
+#     (throughput, then SLO), so a mid-run drop still records something;
+#   - the parent keeps the last metric line, retries the child once after a
+#     crash/hang (re-waiting for the backend), and prints the best line as
+#     its ONLY stdout line — the driver's `parsed` is never null unless the
+#     tunnel was down for the whole retry budget.
+# ---------------------------------------------------------------------------
+
+BACKEND_WAIT_S = float(os.environ.get("BENCH_BACKEND_WAIT", "900"))
+ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "3000"))
+ATTEMPTS = max(1, int(os.environ.get("BENCH_ATTEMPTS", "2")))
+# CPU-only runs (local smoke: JAX_PLATFORMS=cpu) must not wait 15 min for a
+# TPU that can never appear.
+_REQUIRE_TPU = os.environ.get(
+    "BENCH_REQUIRE_TPU",
+    "0" if os.environ.get("JAX_PLATFORMS", "") == "cpu" else "1",
+) == "1"
+
+
+def _log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_backend(timeout_s: float) -> bool:
+    """True iff a fresh process can see an accelerator within timeout_s."""
+    import subprocess
+
+    # The image's sitecustomize re-points jax at "axon,cpu" at interpreter
+    # start, OVERRIDING the env — an explicit JAX_PLATFORMS pin (CPU smoke
+    # runs) must win or the probe hangs on a dead tunnel it was told to
+    # avoid (same fix as runtime/microservice.py:main).
+    code = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "d = jax.devices()\n"
+        "print('PLATFORM=' + d[0].platform)"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except Exception as e:  # TimeoutExpired == hung tunnel
+        _log(f"probe: {type(e).__name__} (tunnel hang?)")
+        return False
+    if r.returncode != 0:
+        # Surface the real failure: a deterministic bring-up error (plugin
+        # crash, import error) would otherwise burn the whole wait budget
+        # with zero diagnostics.
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        _log(f"probe rc={r.returncode}: " + " | ".join(tail))
+        return False
+    plat = ""
+    for ln in r.stdout.splitlines():
+        if ln.startswith("PLATFORM="):
+            plat = ln.split("=", 1)[1]
+    return (plat != "cpu") if _REQUIRE_TPU else bool(plat)
+
+
+def _wait_for_backend(max_wait_s: float) -> bool:
+    deadline = time.monotonic() + max_wait_s
+    delay = 10.0
+    attempt = 0
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return False
+        if left < 20.0:
+            return False  # not enough budget for a meaningful probe
+        attempt += 1
+        _log(f"backend probe #{attempt} ({left:.0f}s of budget left)")
+        if _probe_backend(min(150.0, left)):
+            _log("backend is up")
+            return True
+        time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+        delay = min(delay * 1.6, 60.0)
+
+
+def _run_child(timeout_s: float) -> tuple[int, dict | None]:
+    """Run the measurement child; stream its output; return (rc, last metric)."""
+    import subprocess
+    import threading
+
+    env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+    )
+    got: list[dict] = []
+
+    def reader() -> None:
+        assert proc.stdout is not None
+        for ln in proc.stdout:
+            sys.stderr.write(ln)  # progress mirror; stdout stays parent-only
+            sys.stderr.flush()
+            if ln.lstrip().startswith("{"):
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and "metric" in obj:
+                    got.append(obj)
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _log(f"child exceeded {timeout_s:.0f}s — killing")
+        proc.kill()
+        # Reap before retrying: the dead child must actually release the
+        # TPU (single-claimant tunnel) before the next attempt probes it.
+        # A child stuck in D-state can survive even SIGKILL for a while —
+        # that must not crash the supervisor (the whole point is that a
+        # partial metric already captured in `got` still gets reported).
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            _log("child unreaped after SIGKILL (D-state?) — proceeding")
+        rc = -9
+    th.join(timeout=10)
+    return rc, (got[-1] if got else None)
+
+
+def _supervise() -> None:
+    if not _wait_for_backend(BACKEND_WAIT_S):
+        print(json.dumps({
+            "metric": "engine_req_per_s_per_chip",
+            "value": 0.0,
+            "unit": f"req/s (NO MEASUREMENT: TPU backend unavailable for "
+                    f"{BACKEND_WAIT_S:.0f}s of bring-up retries)",
+            "vs_baseline": 0.0,
+            "detail": {"error": "backend_unavailable"},
+        }))
+        sys.exit(1)
+    best: dict | None = None
+    for attempt in range(ATTEMPTS):
+        if attempt and not _wait_for_backend(600.0):
+            break
+        rc, line = _run_child(ATTEMPT_TIMEOUT_S)
+        partial = bool((line or {}).get("detail", {}).get("partial"))
+        best_partial = bool((best or {}).get("detail", {}).get("partial"))
+        if line is not None and (best is None or best_partial or not partial):
+            best = line  # never let a partial retry clobber a full record
+        if rc == 0 and line is not None and not partial:
+            break
+        _log(f"child attempt {attempt + 1} rc={rc} "
+             f"{'(partial only)' if partial else '(no metric)' if line is None else ''}")
+    if best is not None:
+        print(json.dumps(best))
+        sys.exit(0)
+    print(json.dumps({
+        "metric": "engine_req_per_s_per_chip",
+        "value": 0.0,
+        "unit": "req/s (NO MEASUREMENT: child crashed before any phase "
+                "completed on every attempt)",
+        "vs_baseline": 0.0,
+        "detail": {"error": "child_failed"},
+    }))
+    sys.exit(1)
 
 
 def _measure_slo(params, cfg, sp) -> dict:
@@ -231,6 +404,10 @@ def _measure_slo(params, cfg, sp) -> dict:
 
 def main() -> None:
     import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:  # explicit pin beats the sitecustomize override (see probe)
+        jax.config.update("jax_platforms", plat)
     import numpy as np
 
     from seldon_tpu.models import get_config, init_params
@@ -312,26 +489,37 @@ def main() -> None:
         "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 1),
         "device": str(jax.devices()[0]),
     }
-    if SLO_ENABLED:
-        detail.update(_measure_slo(params, cfg, sp))
-
     req_s = N_REQ / dt
-    print(
-        json.dumps(
-            {
-                "metric": "engine_req_per_s_per_chip",
-                "value": round(req_s, 3),
-                "unit": (
-                    f"req/s (engine, {SLOTS} slots, {N_REQ} concurrent, "
-                    f"prefill{PROMPT_LEN}+decode{NEW_TOKENS}, {PRESET} "
-                    f"{cfg.weight_dtype} weights, {cfg.kv_cache_dtype} kv)"
-                ),
-                "vs_baseline": round(req_s / BASELINE_REQ_S_PER_CHIP, 3),
-                "detail": detail,
-            }
+
+    def emit(partial: bool) -> None:
+        d = dict(detail)
+        if partial:
+            d["partial"] = True  # throughput done, SLO phase still pending
+        print(
+            json.dumps(
+                {
+                    "metric": "engine_req_per_s_per_chip",
+                    "value": round(req_s, 3),
+                    "unit": (
+                        f"req/s (engine, {SLOTS} slots, {N_REQ} concurrent, "
+                        f"prefill{PROMPT_LEN}+decode{NEW_TOKENS}, {PRESET} "
+                        f"{cfg.weight_dtype} weights, {cfg.kv_cache_dtype} kv)"
+                    ),
+                    "vs_baseline": round(req_s / BASELINE_REQ_S_PER_CHIP, 3),
+                    "detail": d,
+                }
+            ),
+            flush=True,
         )
-    )
+
+    if SLO_ENABLED:
+        emit(partial=True)  # phase checkpoint: survives an SLO-phase crash
+        detail.update(_measure_slo(params, cfg, sp))
+    emit(partial=False)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        _supervise()

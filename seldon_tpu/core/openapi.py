@@ -185,6 +185,30 @@ def unit_openapi(service_name: str = "seldon-tpu-microservice") -> Dict:
     }
 
 
+def _with_multipart(op: Dict) -> Dict:
+    """Engine predictions also accept multipart/form-data: file parts map
+    to binData/strData, plain fields parse as JSON subtrees
+    (core/http.py:_merge_multipart; reference
+    RestClientController.java:152-201)."""
+    op = dict(op)
+    op["requestBody"] = dict(op["requestBody"])
+    content = dict(op["requestBody"]["content"])
+    content["multipart/form-data"] = {
+        "schema": {
+            "type": "object",
+            "properties": {
+                "binData": {"type": "string", "format": "binary"},
+                "strData": {"type": "string"},
+                "data": {"type": "string",
+                         "description": "JSON-encoded DefaultData"},
+                "meta": {"type": "string", "description": "JSON-encoded Meta"},
+            },
+        }
+    }
+    op["requestBody"]["content"] = content
+    return op
+
+
 def engine_openapi(predictor: str = "predictor") -> Dict:
     """Spec for the engine's external API (orchestrator/server.py)."""
     return {
@@ -193,7 +217,9 @@ def engine_openapi(predictor: str = "predictor") -> Dict:
                  "version": "0.1.0"},
         "paths": {
             "/api/v0.1/predictions": {
-                "post": _msg_op("Graph prediction", SELDON_MESSAGE_SCHEMA)
+                "post": _with_multipart(
+                    _msg_op("Graph prediction", SELDON_MESSAGE_SCHEMA)
+                )
             },
             "/api/v0.1/feedback": {
                 "post": _msg_op("Graph feedback (bandit reward routing)",

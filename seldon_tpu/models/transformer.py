@@ -330,11 +330,9 @@ def _block(
     if use_ring:
         from seldon_tpu.parallel.ring_attention import ring_attention
 
-        G = cfg.q_per_kv
-        k_exp = jnp.repeat(k, G, axis=2)  # kv heads -> H for the ring
-        v_exp = jnp.repeat(v, G, axis=2)
-        out = ring_attention(q, k_exp, v_exp, ring_mesh, axis="sp",
-                             causal=True)
+        # GQA is native in the ring: only the Hkv-head k/v blocks rotate
+        # over ICI (q_per_kv x less traffic than pre-expanding to H).
+        out = ring_attention(q, k, v, ring_mesh, axis="sp", causal=True)
         attn = out.reshape(B, S, cfg.n_heads * Dh)
     elif use_flash:
         # Full-sequence causal path through the pallas flash kernel
@@ -429,11 +427,8 @@ def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
         if ring_mesh is not None and cfg.attn_impl == "ring" and S > 1:
             from seldon_tpu.parallel.ring_attention import ring_attention
 
-            G = cfg.q_per_kv
-            out = ring_attention(
-                q, jnp.repeat(k, G, axis=2), jnp.repeat(v, G, axis=2),
-                ring_mesh, axis="sp", causal=True,
-            )
+            # Hkv-head k/v rotate directly (GQA native in the ring).
+            out = ring_attention(q, k, v, ring_mesh, axis="sp", causal=True)
             attn = out.reshape(B, S, cfg.n_heads * cfg.head_dim)
         elif cfg.attn_impl == "flash" and S > 1:
             from seldon_tpu.ops.flash_attention import flash_attention

@@ -98,6 +98,7 @@ class EngineServer:
             ),
             batcher=self.batcher,
             metrics_hook=self._on_custom_metric,
+            reward_hook=self._on_reward,
         )
         self.ready_checker = GraphReadyChecker(self.spec)
         self.paused = False  # /pause drains traffic before pod kill
@@ -106,6 +107,9 @@ class EngineServer:
 
     def _on_custom_metric(self, metric: pb.Metric, unit) -> None:
         self.metrics.record_custom([metric])
+
+    def _on_reward(self, unit, reward: float) -> None:
+        self.metrics.record_reward(unit.name, reward)
 
     # --- REST ---------------------------------------------------------------
 
@@ -139,6 +143,8 @@ class EngineServer:
             return reply(out, enc)
 
         async def feedback(request: web.Request) -> web.Response:
+            if self.paused:
+                return web.json_response({"error": "paused"}, status=503)
             t0 = time.perf_counter()
             try:
                 fb, enc = await parse(request, pb.Feedback)
@@ -223,7 +229,14 @@ class EngineServer:
             return out
 
         async def SendFeedback(self, request, context):
-            return await self.outer.engine.send_feedback(request)
+            if self.outer.paused:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
+            t0 = time.perf_counter()
+            out = await self.outer.engine.send_feedback(request)
+            self.outer.metrics.observe(
+                "feedback", "grpc", time.perf_counter() - t0, out
+            )
+            return out
 
     class _SeldonServicerSync:
         """Thread-pool servicer for fully in-process graphs.
@@ -268,9 +281,18 @@ class EngineServer:
             return out
 
         def SendFeedback(self, request, context):
-            return self.outer.engine.drive_sync(
+            # Mirrors the async servicer exactly: pause semantics and the
+            # feedback counter must not depend on which lane a graph rides.
+            if self.outer.paused:
+                context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
+            t0 = time.perf_counter()
+            out = self.outer.engine.drive_sync(
                 self.outer.engine.send_feedback(request)
             )
+            self.outer.metrics.observe(
+                "feedback", "grpc", time.perf_counter() - t0, out
+            )
+            return out
 
     async def start(self, host: str = "0.0.0.0", reuse_port: bool = False):
         app = self.build_app()

@@ -94,12 +94,14 @@ class PredictorEngine:
         client: Optional[InternalClient] = None,
         batcher=None,
         metrics_hook=None,
+        reward_hook=None,
         tracer: Optional[tracing.Tracer] = None,
     ):
         self.spec = spec
         self.client = client or InternalClient()
         self.batcher = batcher
         self.metrics_hook = metrics_hook  # callable(metric: pb.Metric, unit)
+        self.reward_hook = reward_hook  # callable(unit, reward: float)
         self.tracer = tracer or tracing.get_tracer("engine")
         self._hardcoded = {
             u.name: make_hardcoded(u.implementation, u.parameters)
@@ -159,6 +161,15 @@ class PredictorEngine:
         request: pb.SeldonMessage,
         trace_parent: Optional[tracing.SpanContext] = None,
     ) -> pb.SeldonMessage:
+        """Walk the graph for one request and return the merged response.
+
+        OWNERSHIP: the engine takes ownership of `request` and stamps
+        `meta.puid` on it IN PLACE (a fresh puid is minted only when the
+        field is empty). Server paths hand over a per-request message, so
+        this is free; a library caller reusing one SeldonMessage across
+        calls must `request.meta.puid = ""` between calls or every call
+        reuses the first call's puid.
+        """
         puid = request.meta.puid or make_puid()
         ctx = _RequestCtx(puid)
         # The engine owns the request message (every caller — REST parse,
@@ -329,13 +340,12 @@ class PredictorEngine:
                 except UnitCallError:
                     logger.warning("feedback to %s failed", unit.name,
                                    exc_info=True)
-            if self.metrics_hook is not None:
-                reward = pb.Metric(
-                    key="seldon_api_model_feedback_reward",
-                    type=pb.Metric.COUNTER,
-                    value=feedback.reward,
-                )
-                self.metrics_hook(reward, unit)
+            if self.reward_hook is not None:
+                # A dedicated hook, NOT a fabricated custom pb.Metric: the
+                # name would collide with the built-in reward counter in
+                # the prometheus registry and be dropped on every
+                # feedback (engine-level rewards were never recorded).
+                self.reward_hook(unit, feedback.reward)
         routing = feedback.response.meta.routing
         if unit.name in routing:
             branch = routing[unit.name]

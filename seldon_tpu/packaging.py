@@ -95,29 +95,52 @@ def package_model(
     tpu: bool = False,
     image_tag: Optional[str] = None,
     build: bool = False,
+    language: str = "python",
 ) -> Dict[str, str]:
     """Write .seldon-tpu/{Dockerfile,run} into `model_dir`; optionally
-    `docker build`. Returns the generated file paths."""
+    `docker build`. Returns the generated file paths.
+
+    `language`: "python" (default, full seldon_tpu runtime), or "nodejs" /
+    "r" — foreign units speaking the JSON unit protocol (docs/wrappers.md;
+    reference wrappers/s2i/{nodejs,R})."""
     out_dir = os.path.join(model_dir, ".seldon-tpu")
     os.makedirs(out_dir, exist_ok=True)
-    run_path = os.path.join(out_dir, "run")
-    with open(run_path, "w") as f:
-        f.write(generate_entrypoint())
-    os.chmod(run_path, 0o755)
     env = {
         "MODEL_NAME": model_name,
         "SERVICE_TYPE": service_type,
         "API_TYPE": api_type,
         "PERSISTENCE": "0",
     }
-    dockerfile_path = os.path.join(out_dir, "Dockerfile")
-    with open(dockerfile_path, "w") as f:
-        f.write(generate_dockerfile(tpu=tpu, env=env))
     env_path = os.path.join(out_dir, "environment")
     with open(env_path, "w") as f:
         f.write("".join(f"{k}={v}\n" for k, v in env.items()))
-    result = {"dockerfile": dockerfile_path, "run": run_path,
-              "environment": env_path}
+    if language != "python":
+        gen = _FOREIGN_WRAPPERS.get(language)
+        if gen is None:
+            raise ValueError(
+                f"unknown language {language!r}; have python, "
+                + ", ".join(sorted(_FOREIGN_WRAPPERS))
+            )
+        files = gen()
+        result = {"environment": env_path}
+        for rel, content in files.items():
+            path = os.path.join(out_dir, rel)
+            with open(path, "w") as f:
+                f.write(content if rel != "Dockerfile" else _bake_env(
+                    content, env))
+            result[rel.lower().replace(".", "_")
+                   if rel != "Dockerfile" else "dockerfile"] = path
+        dockerfile_path = result["dockerfile"]
+    else:
+        run_path = os.path.join(out_dir, "run")
+        with open(run_path, "w") as f:
+            f.write(generate_entrypoint())
+        os.chmod(run_path, 0o755)
+        dockerfile_path = os.path.join(out_dir, "Dockerfile")
+        with open(dockerfile_path, "w") as f:
+            f.write(generate_dockerfile(tpu=tpu, env=env))
+        result = {"dockerfile": dockerfile_path, "run": run_path,
+                  "environment": env_path}
     if build:
         if shutil.which("docker") is None:
             raise RuntimeError("docker not available for --build")
@@ -128,6 +151,288 @@ def package_model(
         )
         result["image"] = tag
     return result
+
+
+# ---------------------------------------------------------------------------
+# Foreign-language builders (reference wrappers/s2i/{R,nodejs})
+# ---------------------------------------------------------------------------
+#
+# The reference ships full s2i builder images for R and NodeJS
+# (wrappers/s2i/R/Dockerfile:1, wrappers/s2i/nodejs/Dockerfile:1). Here the
+# equivalent is a generated serve shim + Dockerfile speaking the documented
+# JSON unit protocol (docs/wrappers.md): REST routes /predict,
+# /transform-input, /transform-output, /route, /aggregate, /send-feedback
+# (+ /api/v0.1 and /api/v1.0 aliases), /live /ready /metrics, port from
+# PREDICTIVE_UNIT_SERVICE_PORT, CR parameters from
+# PREDICTIVE_UNIT_PARAMETERS, meta echoed through. The shims are original
+# implementations against that protocol, not ports of the reference's.
+
+NODE_MICROSERVICE = """\
+// seldon-tpu NodeJS unit shim — JSON unit protocol (docs/wrappers.md).
+// Zero dependencies: node's http module only. The user module (selected
+// by MODEL_NAME) exports any of: init(params), predict(data, names,
+// meta), transformInput(msg), transformOutput(msg), route(data, names),
+// aggregate(msgs), sendFeedback(reward, request, truth).
+'use strict';
+const http = require('http');
+const path = require('path');
+
+const PORT = parseInt(process.env.PREDICTIVE_UNIT_SERVICE_PORT || '9000', 10);
+const MODEL = process.env.MODEL_NAME || 'MyModel';
+let params = [];
+try { params = JSON.parse(process.env.PREDICTIVE_UNIT_PARAMETERS || '[]'); }
+catch (e) { console.error('bad PREDICTIVE_UNIT_PARAMETERS:', e.message); }
+
+const user = require(path.resolve('/microservice', MODEL));
+if (typeof user.init === 'function') user.init(params);
+
+let requestCount = 0;
+
+function dataOf(msg) {
+  const d = (msg && msg.data) || {};
+  if (d.ndarray !== undefined) return { array: d.ndarray, names: d.names || [] };
+  if (d.tensor !== undefined)
+    return { array: d.tensor.values, shape: d.tensor.shape,
+             names: d.names || [] };
+  return { array: null, names: d.names || [] };
+}
+
+function respond(res, code, obj) {
+  const body = JSON.stringify(obj);
+  res.writeHead(code, { 'Content-Type': 'application/json' });
+  res.end(body);
+}
+
+function outMessage(result, inMsg) {
+  // Echo meta through; reply ndarray unless the user returned a full
+  // SeldonMessage-shaped object ({data: ...} or {strData: ...}).
+  if (result && (result.data !== undefined || result.strData !== undefined ||
+                 result.binData !== undefined || result.jsonData !== undefined)) {
+    result.meta = Object.assign({}, inMsg.meta, result.meta);
+    return result;
+  }
+  return { meta: inMsg.meta || {},
+           data: { names: (result && result.names) || [],
+                   ndarray: (result && result.ndarray !== undefined)
+                            ? result.ndarray : result } };
+}
+
+const handlers = {
+  'predict': (msg) => {
+    const { array, names } = dataOf(msg);
+    return outMessage(user.predict(array, names, msg.meta || {}), msg);
+  },
+  'transform-input': (msg) =>
+    outMessage(user.transformInput ? user.transformInput(msg)
+                                   : dataOf(msg).array, msg),
+  'transform-output': (msg) =>
+    outMessage(user.transformOutput ? user.transformOutput(msg)
+                                    : dataOf(msg).array, msg),
+  'route': (msg) => {
+    const { array, names } = dataOf(msg);
+    const branch = user.route ? user.route(array, names) : -1;
+    return { meta: msg.meta || {}, data: { ndarray: [[branch]] } };
+  },
+  'aggregate': (msgList) => {
+    const msgs = (msgList && msgList.seldonMessages) || [];
+    if (user.aggregate) return outMessage(user.aggregate(msgs), msgs[0] || {});
+    return msgs[0] || {};
+  },
+  'send-feedback': (fb) => {
+    if (user.sendFeedback)
+      user.sendFeedback(fb.reward || 0, fb.request, fb.truth);
+    return { meta: (fb.response && fb.response.meta) || {} };
+  },
+};
+
+const server = http.createServer((req, res) => {
+  const url = req.url.split('?')[0];
+  if (req.method === 'GET') {
+    if (url === '/live' || url === '/ready') return respond(res, 200, { status: 'ok' });
+    if (url === '/metrics') {
+      res.writeHead(200, { 'Content-Type': 'text/plain' });
+      return res.end(
+        '# TYPE unit_requests_total counter\\n' +
+        'unit_requests_total ' + requestCount + '\\n');
+    }
+    return respond(res, 404, { error: 'not found' });
+  }
+  // POST /<verb> or /api/v0.1/<verb> or /api/v1.0/<verb>
+  const verb = url.replace(/^\\/api\\/v[01]\\.[01]\\//, '').replace(/^\\//, '');
+  const handler = handlers[verb];
+  if (!handler) return respond(res, 404, { error: 'no route ' + url });
+  let chunks = [];
+  req.on('data', (c) => chunks.push(c));
+  req.on('end', () => {
+    requestCount += 1;
+    let msg;
+    try {
+      const raw = Buffer.concat(chunks).toString() || '{}';
+      const asForm = raw.startsWith('json=');
+      msg = JSON.parse(asForm ? decodeURIComponent(raw.slice(5).replace(/\\+/g, ' ')) : raw);
+    } catch (e) { return respond(res, 400, { error: 'bad json: ' + e.message }); }
+    try { respond(res, 200, handler(msg)); }
+    catch (e) { respond(res, 500, { error: e.message }); }
+  });
+});
+
+server.listen(PORT, () => console.log(
+  'seldon-tpu node unit ' + MODEL + ' listening on ' + PORT));
+"""
+
+R_MICROSERVICE = """\
+# seldon-tpu R unit shim — JSON unit protocol (docs/wrappers.md).
+# plumber-based like the reference R builder; the user file (selected by
+# MODEL_NAME, sourced from /microservice/<MODEL_NAME>.R) defines any of:
+#   model_init(params), model_predict(data, names), model_route(data,
+#   names), model_transform_input(msg), model_transform_output(msg),
+#   model_send_feedback(reward, request, truth)
+library(plumber)
+library(jsonlite)
+
+port <- as.integer(Sys.getenv("PREDICTIVE_UNIT_SERVICE_PORT", "9000"))
+model <- Sys.getenv("MODEL_NAME", "MyModel")
+params <- tryCatch(
+  fromJSON(Sys.getenv("PREDICTIVE_UNIT_PARAMETERS", "[]"),
+           simplifyVector = FALSE),
+  error = function(e) list())
+
+source(file.path("/microservice", paste0(model, ".R")))
+if (exists("model_init")) model_init(params)
+
+data_of <- function(msg) {
+  d <- msg$data
+  if (!is.null(d$ndarray)) list(array = d$ndarray, names = d$names)
+  else if (!is.null(d$tensor)) list(array = d$tensor$values,
+                                    shape = d$tensor$shape, names = d$names)
+  else list(array = NULL, names = d$names)
+}
+
+out_message <- function(result, in_msg) {
+  if (is.list(result) && (!is.null(result$data) || !is.null(result$strData)))
+    { result$meta <- in_msg$meta; return(result) }
+  list(meta = if (is.null(in_msg$meta)) structure(list(), names = character(0))
+              else in_msg$meta,
+       data = list(ndarray = result))
+}
+
+parse_body <- function(req) {
+  raw <- req$postBody
+  if (startsWith(raw, "json=")) {
+    raw <- URLdecode(gsub("\\\\+", " ", substring(raw, 6)))
+  }
+  fromJSON(raw, simplifyVector = TRUE, simplifyDataFrame = FALSE)
+}
+
+pr <- pr()
+
+handle_verb <- function(verb, fn) {
+  for (route in c(paste0("/", verb),
+                  paste0("/api/v0.1/", verb), paste0("/api/v1.0/", verb))) {
+    pr <<- pr_post(pr, route, fn, serializer = serializer_unboxed_json())
+  }
+}
+
+handle_verb("predict", function(req, res) {
+  msg <- parse_body(req)
+  d <- data_of(msg)
+  out_message(model_predict(d$array, d$names), msg)
+})
+handle_verb("transform-input", function(req, res) {
+  msg <- parse_body(req)
+  if (exists("model_transform_input"))
+    out_message(model_transform_input(msg), msg)
+  else out_message(data_of(msg)$array, msg)
+})
+handle_verb("transform-output", function(req, res) {
+  msg <- parse_body(req)
+  if (exists("model_transform_output"))
+    out_message(model_transform_output(msg), msg)
+  else out_message(data_of(msg)$array, msg)
+})
+handle_verb("route", function(req, res) {
+  msg <- parse_body(req)
+  d <- data_of(msg)
+  branch <- if (exists("model_route")) model_route(d$array, d$names) else -1
+  list(meta = msg$meta, data = list(ndarray = list(list(branch))))
+})
+handle_verb("aggregate", function(req, res) {
+  msg_list <- parse_body(req)
+  msgs <- msg_list$seldonMessages
+  if (exists("model_aggregate")) out_message(model_aggregate(msgs),
+                                             msgs[[1]])
+  else msgs[[1]]
+})
+handle_verb("send-feedback", function(req, res) {
+  fb <- parse_body(req)
+  if (exists("model_send_feedback"))
+    model_send_feedback(fb$reward, fb$request, fb$truth)
+  list(meta = structure(list(), names = character(0)))
+})
+
+pr <- pr_get(pr, "/live", function() list(status = "ok"),
+             serializer = serializer_unboxed_json())
+pr <- pr_get(pr, "/ready", function() list(status = "ok"),
+             serializer = serializer_unboxed_json())
+request_count <- 0
+pr <- pr_filter(pr, "count", function(req) {
+  request_count <<- request_count + 1
+  forward()
+})
+pr <- pr_get(pr, "/metrics", function(res) {
+  res$setHeader("Content-Type", "text/plain")
+  res$body <- paste0("# TYPE unit_requests_total counter\\n",
+                     "unit_requests_total ", request_count, "\\n")
+  res
+}, serializer = serializer_text())
+
+pr_run(pr, host = "0.0.0.0", port = port)
+"""
+
+
+def generate_node_wrapper() -> Dict[str, str]:
+    """NodeJS unit image files: {relpath: content}. The user's model dir
+    holds <MODEL_NAME>.js (CommonJS module per the shim's contract)."""
+    dockerfile = "\n".join([
+        "FROM node:20-slim",
+        "WORKDIR /microservice",
+        "COPY . /microservice",
+        "RUN if [ -f package.json ]; then npm install --omit=dev; fi",
+        "COPY .seldon-tpu/microservice.js /microservice/.seldon-tpu/",
+        "EXPOSE 9000",
+        "ENV PREDICTIVE_UNIT_SERVICE_PORT=9000",
+        'CMD ["node", "/microservice/.seldon-tpu/microservice.js"]',
+    ]) + "\n"
+    return {"Dockerfile": dockerfile, "microservice.js": NODE_MICROSERVICE}
+
+
+def generate_r_wrapper() -> Dict[str, str]:
+    """R (plumber) unit image files: {relpath: content}. The user's model
+    dir holds <MODEL_NAME>.R defining the model_* functions."""
+    dockerfile = "\n".join([
+        "FROM rocker/r-base",
+        "RUN Rscript -e \"install.packages(c('plumber', 'jsonlite'))\"",
+        "WORKDIR /microservice",
+        "COPY . /microservice",
+        "COPY .seldon-tpu/microservice.R /microservice/.seldon-tpu/",
+        "EXPOSE 9000",
+        "ENV PREDICTIVE_UNIT_SERVICE_PORT=9000",
+        'CMD ["Rscript", "/microservice/.seldon-tpu/microservice.R"]',
+    ]) + "\n"
+    return {"Dockerfile": dockerfile, "microservice.R": R_MICROSERVICE}
+
+
+_FOREIGN_WRAPPERS = {"nodejs": generate_node_wrapper, "r": generate_r_wrapper}
+
+
+def _bake_env(dockerfile: str, env: Dict[str, str]) -> str:
+    """Append the unit-contract ENV lines before CMD (the foreign shims
+    are env-driven exactly like the python entrypoint)."""
+    lines = dockerfile.rstrip("\n").split("\n")
+    cmd = lines.pop()
+    lines += [f"ENV {k}={v}" for k, v in env.items()]
+    lines.append(cmd)
+    return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------------------
@@ -236,10 +541,13 @@ def main(argv=None) -> None:  # pragma: no cover - CLI entry
     parser.add_argument("--tpu", action="store_true")
     parser.add_argument("--build", action="store_true")
     parser.add_argument("--image-tag", default=None)
+    parser.add_argument("--language", default="python",
+                        choices=["python", "nodejs", "r"])
     args = parser.parse_args(argv)
     out = package_model(
         args.model_dir, args.model_name, args.service_type, args.api_type,
         tpu=args.tpu, image_tag=args.image_tag, build=args.build,
+        language=args.language,
     )
     for k, v in out.items():
         print(f"{k}: {v}")

@@ -25,14 +25,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attention_update(q, k, v, m, l, acc, q_off, k_off, causal, scale):
+def _block_attention_update(q, k, v, m, l, acc, q_pos, k_off, causal, scale):
     """One online-softmax accumulation step of q against a k/v block.
-    q [BH, s, D]; k,v [BH, t, D]; m,l [BH, s, 1]; acc [BH, s, D] f32."""
+    q [BH, s, D]; k,v [BH, t, D]; m,l [BH, s, 1]; acc [BH, s, D] f32.
+    q_pos [s] — global sequence position of each q row (rows need not be
+    contiguous: the GQA fold interleaves G query groups per kv head)."""
     s_scores = jnp.einsum(
         "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        rows = q_off + jnp.arange(q.shape[1])[:, None]
+        rows = q_pos[:, None]
         cols = k_off + jnp.arange(k.shape[1])[None, :]
         s_scores = jnp.where(rows >= cols, s_scores, NEG_INF)
     m_cur = jnp.max(s_scores, axis=-1, keepdims=True)
@@ -49,27 +51,43 @@ def _block_attention_update(q, k, v, m, l, acc, q_off, k_off, causal, scale):
 
 def ring_attention(
     q: jnp.ndarray,  # [B, S, H, Dh] (global view, S sharded over `axis`)
-    k: jnp.ndarray,  # [B, S, H, Dh] (kv heads pre-expanded to H)
+    k: jnp.ndarray,  # [B, S, Hkv, Dh] — Hkv may be < H (GQA)
     v: jnp.ndarray,
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = True,
 ) -> jnp.ndarray:
     """Exact full attention with S sharded over `axis`. Returns [B,S,H,Dh]
-    sharded the same way."""
+    sharded the same way.
+
+    GQA is native: with Hkv < H query heads group as H = Hkv * G
+    (head h attends kv head h // G, matching gqa_attention), only the
+    Hkv-head K/V blocks rotate around the ring — G× less ICI traffic and
+    G× less resident K/V per device than pre-expanding to H heads — and
+    each rotation's block update batches the G query groups per kv head
+    into one [B*Hkv, G*s, t] matmul."""
 
     def local(q_loc, k_loc, v_loc):
-        # q_loc [B, s, H, Dh] — this device's sequence block.
+        # q_loc [B, s, H, Dh]; k_loc/v_loc [B, s, Hkv, Dh] — this
+        # device's sequence block.
         B, s, H, Dh = q_loc.shape
+        Hkv = k_loc.shape[2]
+        G = H // Hkv
         n = jax.lax.psum(1, axis)
         idx = jax.lax.axis_index(axis)
         scale = Dh**-0.5
 
-        def fold(x):
-            return x.transpose(0, 2, 1, 3).reshape(B * H, s, Dh)
+        def fold_q(x):  # [B, s, H, Dh] -> [B*Hkv, G*s, Dh]
+            return (x.reshape(B, s, Hkv, G, Dh)
+                    .transpose(0, 2, 3, 1, 4)
+                    .reshape(B * Hkv, G * s, Dh))
 
-        qf = fold(q_loc)
-        q_off = idx * s
+        def fold_kv(x):  # [B, s, Hkv, Dh] -> [B*Hkv, s, Dh]
+            return x.transpose(0, 2, 1, 3).reshape(B * Hkv, s, Dh)
+
+        qf = fold_q(q_loc)
+        # Row r of the fold is query position r % s (group r // s).
+        q_pos = idx * s + jnp.arange(G * s) % s
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -80,8 +98,8 @@ def ring_attention(
             def update(args):
                 m, l, acc = args
                 return _block_attention_update(
-                    qf, fold(k_cur), fold(v_cur), m, l, acc,
-                    q_off, src * s, causal, scale,
+                    qf, fold_kv(k_cur), fold_kv(v_cur), m, l, acc,
+                    q_pos, src * s, causal, scale,
                 )
 
             if causal:
@@ -108,15 +126,17 @@ def ring_attention(
             return m, l, acc, k_nxt, v_nxt
 
         init = (
-            jnp.full((B * H, s, 1), NEG_INF, jnp.float32),
-            jnp.zeros((B * H, s, 1), jnp.float32),
-            jnp.zeros((B * H, s, Dh), jnp.float32),
+            jnp.full((B * Hkv, G * s, 1), NEG_INF, jnp.float32),
+            jnp.zeros((B * Hkv, G * s, 1), jnp.float32),
+            jnp.zeros((B * Hkv, G * s, Dh), jnp.float32),
             k_loc,
             v_loc,
         )
         m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, init)
         out = (acc / jnp.maximum(l, 1e-30)).astype(q_loc.dtype)
-        return out.reshape(B, H, s, Dh).transpose(0, 2, 1, 3)
+        return (out.reshape(B, Hkv, G, s, Dh)
+                .transpose(0, 3, 1, 2, 4)
+                .reshape(B, s, H, Dh))
 
     spec = P(None, axis, None, None)
     return shard_map(

@@ -689,7 +689,11 @@ class InferenceEngine:
         if free < sat:
             return sizes[-1]  # saturated: nothing admittable mid-chunk
         if free < n_slots // 4:
-            return sizes[len(sizes) // 2]  # near-saturation: split the cost
+            # Mid rung, capped below the top: with only two rungs
+            # (e.g. decode_chunk=8, min_chunk=4 dedups to (4, 8)),
+            # len//2 would resolve to the TOP rung and near-saturation
+            # would silently lose its admission boundaries.
+            return sizes[min(len(sizes) // 2, len(sizes) - 2)]
         return sizes[0]
 
     def _recycle_budget_spent(self, roster: List[Optional[_Request]],

@@ -97,6 +97,36 @@ def test_ring_attention_matches_reference(causal):
                                atol=2e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_gqa_matches_expanded(causal):
+    """GQA ring (Hkv-head k/v rotate) == pre-expanded full-head ring."""
+    mesh = make_mesh(MeshPlan(sp=4, dp=2))
+    B, S, H, Hkv, Dh = 2, 32, 8, 2, 16
+    G = H // Hkv
+    key = jax.random.key(9)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, Dh))
+    k = jax.random.normal(kk, (B, S, Hkv, Dh))
+    v = jax.random.normal(kv, (B, S, Hkv, Dh))
+
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs = jax.device_put(q, spec)
+    ks, vs = (jax.device_put(x, spec) for x in (k, v))
+    out = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh, causal=causal)
+    )(qs, ks, vs)
+
+    # Head h must attend kv head h // G — same convention as
+    # gqa_attention's reshape(B, S, Hkv, G, Dh).
+    k_exp = jax.device_put(jnp.repeat(k, G, axis=2), spec)
+    v_exp = jax.device_put(jnp.repeat(v, G, axis=2), spec)
+    ref = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh, causal=causal)
+    )(qs, k_exp, v_exp)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
+
+
 def test_ring_attention_grad_flows():
     mesh = make_mesh(MeshPlan(sp=2))
     B, S, H, Dh = 1, 16, 2, 8

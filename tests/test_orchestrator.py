@@ -268,6 +268,36 @@ def test_feedback_follows_routing(unit_servers):
     assert router_obj.feedback_seen[0][0] == 0.75
 
 
+def test_feedback_reward_hook_records_counter(unit_servers):
+    """Engine-level rewards ride the dedicated reward_hook into the
+    built-in counter — a fabricated custom pb.Metric would collide with
+    that counter's registry name and be silently dropped (r5 fix)."""
+    from seldon_tpu.runtime.metrics_server import ServerMetrics
+
+    sm = ServerMetrics()
+    seen = []
+    eng = PredictorEngine(
+        graph_with_router(unit_servers),
+        reward_hook=lambda unit, r: (seen.append(unit.name),
+                                     sm.record_reward(unit.name, r)),
+    )
+
+    async def go():
+        fb = pb.Feedback()
+        fb.reward = 0.75
+        fb.response.meta.puid = "x"
+        await eng.send_feedback(fb)
+        await eng.close()
+
+    run(go())
+    assert seen, "reward hook should fire for model/router units"
+    body, _ = sm.export()
+    # The SAMPLE line, not just the header (# HELP/# TYPE lines exist
+    # even when nothing was recorded).
+    assert b'seldon_api_model_feedback_reward_total{unit="' in body
+    assert b"} 0.75" in body
+
+
 def test_combiner_over_microservices(unit_servers):
     spec = spec_from(
         {
@@ -381,6 +411,77 @@ def test_batcher_fuses_concurrent_requests():
 # ---------------------------------------------------------------------------
 # Engine server (REST external surface)
 # ---------------------------------------------------------------------------
+
+
+def test_engine_server_multipart_prediction():
+    """multipart/form-data predictions: file parts -> binData/strData,
+    plain fields -> JSON subtrees (reference engine
+    RestClientController.java:152-201)."""
+    spec = spec_from(
+        {"name": "p", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+    )
+
+    async def go():
+        import aiohttp
+
+        server = EngineServer(spec=spec, http_port=0, grpc_port=0)
+        await server.start(host="127.0.0.1")
+        url = f"http://127.0.0.1:{server.http_port}"
+        async with aiohttp.ClientSession() as s:
+            # Binary file part under binData + a meta JSON field.
+            form = aiohttp.FormData()
+            form.add_field("binData", b"\x00\x01\xffpayload",
+                           filename="blob.bin",
+                           content_type="application/octet-stream")
+            form.add_field("meta", '{"tags": {"src": "upload"}}')
+            async with s.post(f"{url}/api/v0.1/predictions", data=form) as r:
+                body = await r.json()
+                status = r.status
+            # strData file part (case-insensitive key, reference parity).
+            form2 = aiohttp.FormData()
+            form2.add_field("strdata", b"hello text",
+                            filename="doc.txt", content_type="text/plain")
+            async with s.post(f"{url}/api/v0.1/predictions", data=form2) as r2:
+                status2 = r2.status
+                body2 = await r2.json()
+        await server.stop()
+        return status, body, status2, body2
+
+    status, body, status2, body2 = run(go())
+    assert status == 200, body
+    # binData input has no array kind -> model answers in dense form.
+    assert body["data"]["names"] == ["proba0", "proba1", "proba2"]
+    assert body["meta"]["tags"]["src"] == "upload"  # meta field parsed
+    assert status2 == 200, body2
+
+
+def test_parse_multipart_message_fields():
+    """_merge_multipart maps parts onto the SeldonMessage oneof."""
+    import base64
+
+    from seldon_tpu.core.http import _merge_multipart
+
+    class FileLike:
+        def __init__(self, data):
+            import io
+            self.file = io.BytesIO(data)
+
+    form = {
+        "binData": FileLike(b"\x01\x02\x03"),
+        "meta": '{"puid": "abc"}',
+    }
+    msg = _merge_multipart(form, pb.SeldonMessage)
+    assert msg.binData == b"\x01\x02\x03"
+    assert msg.meta.puid == "abc"
+
+    msg2 = _merge_multipart({"strData": FileLike(b"text here")},
+                            pb.SeldonMessage)
+    assert msg2.strData == "text here"
+    # Plain base64 text field under binData.
+    msg3 = _merge_multipart(
+        {"bindata": base64.b64encode(b"zz").decode()}, pb.SeldonMessage
+    )
+    assert msg3.binData == b"zz"
 
 
 def test_engine_server_rest_roundtrip():

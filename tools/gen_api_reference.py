@@ -1,0 +1,166 @@
+"""Render docs/api-reference.md from core/openapi.py — one source of
+truth, so the API reference cannot drift from the servers that mount the
+spec (tests/test_docs.py pins the rendered output).
+
+Run:  python tools/gen_api_reference.py [--check]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from seldon_tpu.core.openapi import (  # noqa: E402
+    SELDON_MESSAGE_SCHEMA, engine_openapi, unit_openapi,
+)
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "api-reference.md")
+
+
+def _routes_table(spec: dict, skip_prefix: str | None = None) -> str:
+    rows = ["| Route | Method | Summary | Responses |",
+            "|---|---|---|---|"]
+    for route in spec["paths"]:
+        if skip_prefix and route.startswith(skip_prefix):
+            continue
+        for method, op in spec["paths"][route].items():
+            responses = ", ".join(
+                f"{code} ({d.get('description', '')})"
+                for code, d in op.get("responses", {}).items()
+            )
+            body = op.get("requestBody", {}).get("content", {})
+            content = " + ".join(sorted(body)) if body else "—"
+            rows.append(
+                f"| `{route}` | {method.upper()} | {op.get('summary', '')} "
+                f"[{content}] | {responses} |"
+            )
+    return "\n".join(rows)
+
+
+def _schema_fields(schema: dict, prefix: str = "") -> list[str]:
+    out = []
+    for name, sub in schema.get("properties", {}).items():
+        t = sub.get("type", "object")
+        if t == "object" and "properties" in sub:
+            out.append(f"- `{prefix}{name}` (object)")
+            out.extend(_schema_fields(sub, prefix + name + "."))
+        elif t == "array":
+            item = sub.get("items", {}).get("type", "any")
+            out.append(f"- `{prefix}{name}` (array of {item})")
+        else:
+            enum = sub.get("enum")
+            suffix = f", one of {enum}" if enum else ""
+            out.append(f"- `{prefix}{name}` ({t}{suffix})")
+    return out
+
+
+def render() -> str:
+    engine = engine_openapi()
+    unit = unit_openapi()
+    return f"""# API reference
+
+Generated from `seldon_tpu/core/openapi.py` by
+`tools/gen_api_reference.py` — do not edit by hand; regenerate with
+`python tools/gen_api_reference.py`. The same spec is served live at
+`GET /seldon.json` by both the engine and every unit microservice
+(reference: `openapi/` apife.oas3.json + engine.oas3.json).
+
+## Engine (service orchestrator) external API
+
+`orchestrator/server.py` — the per-deployment entrypoint the ingress
+routes to.
+
+{_routes_table(engine)}
+
+`POST /api/v0.1/predictions` content types: JSON `SeldonMessage`,
+binary proto (`application/x-protobuf`), HTML form (`json=` field), and
+`multipart/form-data` — file parts land in `binData` (bytes) or
+`strData` (text, key matched case-insensitively), plain fields are
+parsed as JSON subtrees (`data`, `meta`, `jsonData`).
+
+## Unit microservice API
+
+`runtime/wrapper.py` — what the engine dials internally and what a
+foreign-language unit must implement (see `docs/wrappers.md`). Routes
+are also mounted under `/api/v0.1/...` and `/api/v1.0/...` aliases
+(elided below).
+
+{_routes_table(unit, skip_prefix="/api/v0.1")}
+
+## SeldonMessage
+
+The one message shape of the whole protocol
+(`seldon_tpu/proto/prediction.proto`). Exactly one of the data kinds is
+set: `data` (names + one of ndarray / tensor / dense), `binData`,
+`strData`, `jsonData`.
+
+{chr(10).join(_schema_fields(SELDON_MESSAGE_SCHEMA))}
+
+`data.dense` is the TPU-native zero-copy kind: raw little-endian bytes
+plus dtype + shape (bf16-capable) — what the TPU units speak among
+themselves.
+
+## Meta merge semantics
+
+How `meta` accumulates as a request walks the graph
+(`orchestrator/walker.py:_RequestCtx`; reference
+`PredictiveUnitBean.java:370-388`):
+
+- **puid** — minted by the engine when the inbound request carries
+  none; stamped on the request IN PLACE (the engine owns the request
+  message) and echoed on the response. Every unit sees the same puid.
+- **tags** — merged across every unit response in completion order;
+  later writers override earlier ones key-by-key (`merge_response_meta`
+  copies per key). The final response carries the union.
+- **routing** — written by the engine, not the units: for each ROUTER
+  unit, the branch index it chose (`-1` = fan-out to all children).
+  Feedback follows these breadcrumbs back down
+  (`walker.py:send_feedback`): a feedback's `response.meta.routing`
+  decides which child subtree receives it.
+- **requestPath** — written by the engine: every unit the request
+  actually visited, mapped to its serving image (audit trail; the A/B
+  test assertions in `tests/test_orchestrator.py` key off it).
+- **metrics** — APPEND-only across units (no dedup by key: two units
+  emitting the same counter key both appear; the prometheus registry
+  sums COUNTERs and last-writes GAUGEs when absorbing them). Custom
+  entries are absorbed into the engine's registry
+  (`metrics_server.py:record_custom`) AND returned to the caller.
+- **feedback rewards** — `POST /api/v0.1/feedback` routes
+  `Feedback.reward` to every MODEL/ROUTER unit on the stored routing
+  path; the engine counts them per unit
+  (`seldon_api_model_feedback_reward_total`, negative rewards on the
+  `_negative` series since counters cannot decrease).
+
+## gRPC
+
+Same surface over gRPC (`seldon_tpu/proto/prediction.proto`):
+`Seldon.Predict` / `Seldon.SendFeedback` on the engine;
+`Model.Predict`, `Generic.Transform{{Input,Output}}`, `Router.Route`,
+`Combiner.Aggregate`, `Generic.SendFeedback` on units. Method paths:
+`/seldon_tpu.protos.<Service>/<Method>`. In-process graphs ride a
+sync thread-pool servicer; graphs with network units ride asyncio
+(`orchestrator/server.py`).
+"""
+
+
+def main() -> None:
+    text = render()
+    if "--check" in sys.argv:
+        with open(OUT) as f:
+            if f.read() != text:
+                print("docs/api-reference.md is stale — rerun "
+                      "python tools/gen_api_reference.py", file=sys.stderr)
+                sys.exit(1)
+        print("api-reference.md up to date")
+        return
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {os.path.normpath(OUT)} ({len(text.splitlines())} lines)")
+
+
+if __name__ == "__main__":
+    main()
