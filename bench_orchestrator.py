@@ -32,11 +32,19 @@ import time
 
 import numpy as np
 
-CLIENTS = int(os.environ.get("BENCH_ORCH_CLIENTS", "64"))
-CLIENT_PROCS = int(os.environ.get("BENCH_ORCH_CLIENT_PROCS", "4"))
+# 32 clients / 2 procs measured best on the 1-core bench box: more client
+# processes steal server time slices and cache (the reference's own rig
+# kept load generators on separate NODES); 2 procs already saturate the
+# engine (wall req/s and per-core both HIGHER than with 4 procs).
+CLIENTS = int(os.environ.get("BENCH_ORCH_CLIENTS", "32"))
+CLIENT_PROCS = int(os.environ.get("BENCH_ORCH_CLIENT_PROCS", "2"))
 SECONDS = float(os.environ.get("BENCH_ORCH_SECONDS", "12"))  # 5s windows are too noisy on small boxes
 TRANSPORTS = os.environ.get("BENCH_ORCH_TRANSPORTS", "rest,grpc").split(",")
 PAYLOADS = os.environ.get("BENCH_ORCH_PAYLOADS", "ndarray,dense").split(",")
+# inproc = hardcoded SIMPLE_MODEL (sync gRPC lane, the reference's own
+# stub methodology); netunit = one real microservice subprocess (async
+# lane — what every deployed graph rides).
+GRAPHS = os.environ.get("BENCH_ORCH_GRAPHS", "inproc,netunit").split(",")
 
 REF_PER_CORE = {  # benchmarking.md:40-58 on n1-standard-16
     "rest": 12088.95 / 16.0,
@@ -44,24 +52,69 @@ REF_PER_CORE = {  # benchmarking.md:40-58 on n1-standard-16
 }
 
 
-def build_server():
-    from seldon_tpu.orchestrator.server import EngineServer
-    from seldon_tpu.orchestrator.spec import PredictiveUnit, PredictorSpec
+class EchoModel:
+    """Network-unit stub: the cheapest possible real microservice, so the
+    netunit rows measure ENGINE orchestration cost (async walker +
+    internal client), not model compute — the async-path analogue of the
+    reference's SIMPLE_MODEL methodology."""
 
-    spec = PredictorSpec(
-        name="bench",
-        graph=PredictiveUnit(
-            name="simple", type="MODEL", implementation="SIMPLE_MODEL"
-        ),
+    def predict(self, X, names, meta=None):
+        return X
+
+
+def build_server(unit_addr: str = ""):
+    from seldon_tpu.orchestrator.server import EngineServer
+    from seldon_tpu.orchestrator.spec import (
+        Endpoint, PredictiveUnit, PredictorSpec,
     )
+
+    if unit_addr:
+        # One REAL network unit: the graph walk leaves the process — the
+        # path every deployed (non-hardcoded) graph rides. Native units
+        # also expose the framed-proto fast lane (runtime/fastpath.py) on
+        # port+1; BENCH_ORCH_FAST=0 pins the hop to full gRPC for A/B.
+        host, port = unit_addr.rsplit(":", 1)
+        fast = os.environ.get("BENCH_ORCH_FAST", "1") != "0"
+        graph = PredictiveUnit(
+            name="echo", type="MODEL",
+            endpoint=Endpoint(service_host=host, service_port=int(port),
+                              fast_port=int(port) + 1 if fast else 0),
+        )
+    else:
+        graph = PredictiveUnit(
+            name="simple", type="MODEL", implementation="SIMPLE_MODEL"
+        )
+    spec = PredictorSpec(name="bench", graph=graph)
     # Batching off: SIMPLE_MODEL is hardcoded in-process (no leaf to fuse
     # for) and the reference bench has no batcher either.
     return EngineServer(spec=spec, http_port=0, grpc_port=0,
                         enable_batching=False)
 
 
-async def serve_forever():
-    es = build_server()
+def serve_unit() -> None:
+    """gRPC echo microservice subprocess (its CPU is NOT counted in the
+    per-engine-core metric — deployed units run in their own pods).
+    Serves the fast lane on port+1, like the microservice CLI."""
+    from seldon_tpu.runtime.fastpath import start_fast_server
+    from seldon_tpu.runtime.wrapper import build_grpc_server
+
+    model = EchoModel()
+    srv = build_grpc_server(model)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    if os.environ.get("BENCH_ORCH_FAST", "1") != "0":
+        try:
+            start_fast_server(model, "127.0.0.1", port + 1)
+        except OSError:
+            # port+1 taken: the engine's refused-connect fallback rides
+            # gRPC; a bind race must not kill the whole bench run.
+            pass
+    print(json.dumps({"unit_port": port}), flush=True)
+    srv.wait_for_termination()
+
+
+async def serve_forever(unit_addr: str = ""):
+    es = build_server(unit_addr)
     await es.start(host="127.0.0.1")
     http_port = None
     for site in es._runner.sites:
@@ -155,12 +208,14 @@ async def bench_grpc(grpc_port: int, kind: str, seconds: float, clients: int):
 def report(name: str, kind: str, total: int, dt: float, p50: float,
            p99: float, cpu_s: float, ref_per_core: float):
     per_core = total / cpu_s if cpu_s > 0 else float("nan")
+    graph_label = ("echo-unit subprocess graph" if "netunit" in name
+                   else "SIMPLE_MODEL graph")
     print(json.dumps({
         "metric": name,
         "value": round(per_core, 1),
         "unit": (
             f"req/s per server core ({kind} payload, {CLIENTS} clients / "
-            f"{CLIENT_PROCS} procs, SIMPLE_MODEL graph, {SECONDS}s)"
+            f"{CLIENT_PROCS} procs, {graph_label}, {SECONDS}s)"
         ),
         "vs_baseline": round(per_core / ref_per_core, 3),
         "detail": {
@@ -196,8 +251,9 @@ def run_clients(transport, port, kind, seconds, clients):
     separate NODES, benchmarking.md:40-58)."""
     per = max(1, clients // CLIENT_PROCS)
     actual = per * CLIENT_PROCS  # report what actually ran
-    global CLIENTS
-    CLIENTS = actual
+    if clients >= 16:  # don't let the 8-client warm run clobber the label
+        global CLIENTS
+        CLIENTS = actual
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--client",
@@ -227,14 +283,24 @@ def run_clients(transport, port, kind, seconds, clients):
     return total, dt, p50, p99
 
 
-async def main():
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--serve"],
-        stdout=subprocess.PIPE,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
+async def run_scenario(graph: str):
+    """One engine topology: 'inproc' (hardcoded SIMPLE_MODEL, sync gRPC
+    lane) or 'netunit' (one real gRPC microservice subprocess, async
+    lane). Metric rows carry the scenario in their name."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    unit_proc = None
+    serve_cmd = [sys.executable, os.path.abspath(__file__), "--serve"]
+    if graph == "netunit":
+        unit_proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--serve-unit"],
+            stdout=subprocess.PIPE, cwd=here,
+        )
+        unit_port = json.loads(unit_proc.stdout.readline())["unit_port"]
+        serve_cmd += ["--unit", f"127.0.0.1:{unit_port}"]
+    proc = subprocess.Popen(serve_cmd, stdout=subprocess.PIPE, cwd=here)
     try:
         ports = json.loads(proc.stdout.readline())
+        suffix = "_netunit" if graph == "netunit" else ""
 
         def run(transport, kind, seconds, clients):
             port = (ports["http_port"] if transport == "rest"
@@ -248,18 +314,30 @@ async def main():
                 total, dt, p50, p99 = run(transport, kind, SECONDS, CLIENTS)
                 cpu1 = server_cpu_seconds(proc.pid)
                 report(
-                    f"engine_{transport}_req_per_s_per_core", kind,
+                    f"engine_{transport}{suffix}_req_per_s_per_core", kind,
                     total, dt, p50, p99, cpu1 - cpu0,
                     REF_PER_CORE[transport],
                 )
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+        if unit_proc is not None:
+            unit_proc.terminate()
+            unit_proc.wait(timeout=10)
+
+
+async def main():
+    for graph in GRAPHS:
+        await run_scenario(graph)
 
 
 if __name__ == "__main__":
-    if "--serve" in sys.argv:
-        asyncio.run(serve_forever())
+    if "--serve-unit" in sys.argv:
+        serve_unit()
+    elif "--serve" in sys.argv:
+        unit = (sys.argv[sys.argv.index("--unit") + 1]
+                if "--unit" in sys.argv else "")
+        asyncio.run(serve_forever(unit))
     elif "--client" in sys.argv:
         i = sys.argv.index("--client")
         transport, port, kind, seconds, clients = sys.argv[i + 1:i + 6]

@@ -37,6 +37,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _free_port_pair() -> int:
+    """A port p where p+1 is ALSO free — unit processes serve gRPC on p
+    and the framed-proto fast lane on p+1, and p+1 must not be handed to
+    the next unit/engine by a later ephemeral allocation (the engine
+    would then frame bytes at a foreign gRPC socket: connect succeeds,
+    so the refused-connect fallback never fires)."""
+    for _ in range(64):
+        with socket.socket() as a:
+            a.bind(("127.0.0.1", 0))
+            p = a.getsockname()[1]
+            with socket.socket() as b:
+                try:
+                    b.bind(("127.0.0.1", p + 1))
+                except OSError:
+                    continue
+                return p
+    return _free_port()  # degenerate host: fall back, fast lane may miss
+
+
 def _proc_sink():
     """SELDON_TPU_LOCALSTORE_DEBUG=1 lets spawned pods inherit stdio
     (debugging a pod that never becomes ready); default devnull."""
@@ -168,7 +187,7 @@ class LocalProcessStore:
                 engine_container = c
                 continue
             env = self._env_list_to_dict(c.get("env"))
-            port = _free_port()
+            port = _free_port_pair()
             unit_ports[c["name"]] = port
             pod.ports[c["name"]] = port
             mdir = local_model_dir(c)
@@ -217,10 +236,15 @@ class LocalProcessStore:
 
                 def patch(unit: Dict) -> None:
                     if unit.get("name") in unit_ports:
+                        uport = unit_ports[unit["name"]]
                         unit["endpoint"] = {
                             "service_host": "127.0.0.1",
-                            "service_port": unit_ports[unit["name"]],
+                            "service_port": uport,
                             "type": "GRPC",
+                            # The microservice serves the framed-proto
+                            # fast lane on grpc_port+1 — same contract
+                            # as the webhook's fastPort defaulting.
+                            "fast_port": uport + 1,
                         }
                     for child in unit.get("children", []) or []:
                         patch(child)

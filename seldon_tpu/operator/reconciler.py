@@ -113,11 +113,19 @@ def _unit_container(sdep: T.SeldonDeployment, pred: T.PredictorExt,
     else:
         command = None  # user image brings its own entrypoint
     port = unit.endpoint.service_port if unit.endpoint else T.FIRST_UNIT_PORT
+    # The engine dials service_port with the endpoint's type, so the
+    # container must bind THAT protocol on THAT port: without pinning
+    # API_TYPE, the microservice defaults to REST,GRPC and puts gRPC on
+    # port+1 while a GRPC-type endpoint dials port (latent mismatch).
+    # The fast lane (fastPort = port+1, webhook stride 2) lands on
+    # grpc_port+1 either way.
+    api_type = (unit.endpoint.type.value if unit.endpoint else "GRPC")
     container: Dict[str, Any] = {
         "name": unit.name,
         "image": unit.image or T.DEFAULT_SERVER_IMAGE,
         "env": [
             {"name": T.ENV_PREDICTIVE_UNIT_SERVICE_PORT, "value": str(port)},
+            {"name": "API_TYPE", "value": api_type},
             {"name": T.ENV_PREDICTIVE_UNIT_ID, "value": unit.name},
             {"name": T.ENV_PREDICTOR_ID, "value": pred.spec.name},
             {"name": T.ENV_SELDON_DEPLOYMENT_ID, "value": sdep.name},

@@ -28,6 +28,7 @@ ANNOTATION_REST_READ_TIMEOUT = "seldon.io/rest-read-timeout"
 ANNOTATION_GRPC_READ_TIMEOUT = "seldon.io/grpc-read-timeout"
 ANNOTATION_GRPC_MAX_MSG = "seldon.io/grpc-max-message-size"
 # Ambassador behavior knobs (reference ambassador.go:13-18).
+ANNOTATION_FASTPATH = "seldon.io/fastpath"
 ANNOTATION_AMBASSADOR_CUSTOM = "seldon.io/ambassador-config"
 ANNOTATION_AMBASSADOR_SHADOW = "seldon.io/ambassador-shadow"
 ANNOTATION_AMBASSADOR_SERVICE = "seldon.io/ambassador-service-name"

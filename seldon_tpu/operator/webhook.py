@@ -72,7 +72,12 @@ def default_deployment(sdep: T.SeldonDeployment) -> T.SeldonDeployment:
                 unit.endpoint.service_port != port
             ):
                 unit.endpoint.service_port = port
-            port = max(port, unit.endpoint.service_port) + 1
+            # Stride 2: seldon-tpu-native units serve the framed-proto
+            # fast lane on service_port+1 (runtime/fastpath.py), so
+            # consecutive allocation would collide with the next unit.
+            port = max(port, unit.endpoint.service_port) + 2
+            if not unit.endpoint.fast_port and _serves_fastpath(sdep, unit):
+                unit.endpoint.fast_port = unit.endpoint.service_port + 1
             # Engine shares the pod with units unless separate-pod: then
             # units resolve via their container service DNS
             # (webhook.go:224-231).
@@ -85,6 +90,19 @@ def default_deployment(sdep: T.SeldonDeployment) -> T.SeldonDeployment:
                 else:
                     unit.endpoint.service_host = "localhost"
     return sdep
+
+
+def _serves_fastpath(sdep: T.SeldonDeployment, unit) -> bool:
+    """Native images (our microservice runtime) serve the fast lane on
+    gRPC-port+1; foreign images don't unless they opt in via the
+    `seldon.io/fastpath: "true"` annotation ("false" opts native units
+    out — e.g. when a NetworkPolicy only admits the gRPC port)."""
+    override = sdep.annotations.get(T.ANNOTATION_FASTPATH, "")
+    if override in ("true", "false"):
+        return override == "true"
+    image = unit.image or ""
+    return (image.startswith("seldon-tpu/") or image.startswith("local/")
+            or unit.implementation in PREPACKAGED)
 
 
 def _default_traffic(sdep: T.SeldonDeployment) -> None:

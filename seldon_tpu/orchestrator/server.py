@@ -21,7 +21,11 @@ from seldon_tpu.core import payloads, tracing
 from seldon_tpu.core.annotations import AnnotationsConfig
 from seldon_tpu.core.http import PROTO_CONTENT_TYPE, parse_message, reply
 from seldon_tpu.orchestrator.batcher import MicroBatcher
-from seldon_tpu.orchestrator.client import InternalClient, UnitCallError
+from seldon_tpu.orchestrator.client import (
+    InternalClient,
+    SyncInternalClient,
+    UnitCallError,
+)
 from seldon_tpu.orchestrator.reqlogger import RequestLogger
 from seldon_tpu.orchestrator.spec import (
     HARDCODED_IMPLEMENTATIONS,
@@ -100,6 +104,25 @@ class EngineServer:
             metrics_hook=self._on_custom_metric,
             reward_hook=self._on_reward,
         )
+        # A second engine over a BLOCKING gRPC client backs the sync
+        # thread-pool gRPC lane whenever the graph allows it (linear or
+        # router graphs with gRPC-endpoint units, unbatched) — the lane
+        # used to require a fully in-process graph; now every deployed
+        # gRPC-unit graph rides C completion queues instead of asyncio.
+        # The asyncio engine still serves REST and any non-eligible graph.
+        self.engine_sync: Optional[PredictorEngine] = None
+        if PredictorEngine.sync_drivable(self.spec, self.batcher):
+            self.engine_sync = PredictorEngine(
+                self.spec,
+                client=SyncInternalClient(
+                    timeout_s=self.annotations.rest_timeout_s(30000),
+                    retries=self.annotations.connect_retries(3),
+                    max_message_bytes=self.grpc_max_msg,
+                ),
+                batcher=None,
+                metrics_hook=self._on_custom_metric,
+                reward_hook=self._on_reward,
+            )
         self.ready_checker = GraphReadyChecker(self.spec)
         self.paused = False  # /pause drains traffic before pod kill
         self._grpc_server: Optional[grpc.aio.Server] = None
@@ -259,11 +282,11 @@ class EngineServer:
                 context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
             t0 = time.perf_counter()
             try:
-                out = outer.engine.predict_sync(
+                out = outer.engine_sync.predict_sync(
                     request,
                     trace_parent=(
                         tracing.Tracer.extract(context.invocation_metadata())
-                        if outer.engine.tracer.enabled else None
+                        if outer.engine_sync.tracer.enabled else None
                     ),
                 )
             except UnitCallError as e:
@@ -286,8 +309,8 @@ class EngineServer:
             if self.outer.paused:
                 context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
             t0 = time.perf_counter()
-            out = self.outer.engine.drive_sync(
-                self.outer.engine.send_feedback(request)
+            out = self.outer.engine_sync.drive_sync(
+                self.outer.engine_sync.send_feedback(request)
             )
             self.outer.metrics.observe(
                 "feedback", "grpc", time.perf_counter() - t0, out
@@ -309,13 +332,17 @@ class EngineServer:
             # Worker processes share the port (kernel load-balanced).
             ("grpc.so_reuseport", 1 if reuse_port else 0),
         ]
-        if self.engine.all_hardcoded:
+        if self.engine_sync is not None:
             from concurrent import futures
 
             self._grpc_server = grpc.server(
                 futures.ThreadPoolExecutor(
+                    # 16 measured best on the netunit bench: with a
+                    # blocking unit hop in the handler, deeper in-flight
+                    # amortizes poller wakeups (8 -> 1.56x, 16 -> 2.06x,
+                    # 32+ thrashes); in-process graphs are insensitive.
                     max_workers=int(
-                        os.environ.get("SELDON_TPU_GRPC_WORKERS", "8")
+                        os.environ.get("SELDON_TPU_GRPC_WORKERS", "16")
                     )
                 ),
                 options=grpc_options,
@@ -357,6 +384,8 @@ class EngineServer:
             await self._runner.cleanup()
         await self.reqlogger.close()
         await self.engine.close()
+        if self.engine_sync is not None:
+            await self.engine_sync.close()
 
 
 def _worker_main(http_port: int, grpc_port: int, enable_batching: bool,

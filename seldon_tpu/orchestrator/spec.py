@@ -56,6 +56,10 @@ class Endpoint:
     # franca for foreign-language units, e.g. examples/wrappers/go —
     # docs/wrappers.md).
     content: str = "proto"
+    # Optional framed-proto fast lane (runtime/fastpath.py): seldon-tpu
+    # native units serve it on gRPC-port+1 alongside gRPC/REST; 0 =
+    # absent, the engine uses `type` as usual. Sync-lane only.
+    fast_port: int = 0
 
     @staticmethod
     def from_dict(d: Dict) -> "Endpoint":
@@ -72,6 +76,7 @@ class Endpoint:
             service_port=int(d.get("service_port", d.get("servicePort", 9000))),
             type=EndpointType(d.get("type", "GRPC")),
             content=content,
+            fast_port=int(d.get("fast_port", d.get("fastPort", 0))),
         )
 
     def to_dict(self) -> Dict:
@@ -82,6 +87,8 @@ class Endpoint:
         }
         if self.content != "proto":
             out["content"] = self.content
+        if self.fast_port:
+            out["fast_port"] = self.fast_port
         return out
 
 

@@ -36,6 +36,7 @@ from seldon_tpu.orchestrator.client import (
 )
 from seldon_tpu.orchestrator.spec import (
     HARDCODED_IMPLEMENTATIONS,
+    EndpointType,
     PredictiveUnit,
     PredictorSpec,
     UnitType,
@@ -114,6 +115,12 @@ class PredictorEngine:
         self._all_hardcoded = self.batcher is None and all(
             u.name in self._hardcoded for u in spec.graph.walk()
         )
+        # A walk never suspends when every unit is in-process OR the
+        # client itself blocks (SyncInternalClient) — fan-outs then run
+        # sequentially instead of as gathered tasks.
+        self._sequential = self._all_hardcoded or bool(
+            getattr(self.client, "is_sync", False)
+        )
         self._span_info = {
             u.name: (
                 f"unit.{u.name}",
@@ -121,6 +128,17 @@ class PredictorEngine:
             )
             for u in spec.graph.walk()
         }
+        # Solo-MODEL fast walk: the single most common deployed graph is
+        # one network MODEL unit; its full walk is ~10 coroutine frames +
+        # a ctx object per request. predict_sync collapses that to one
+        # driven client call with identical meta semantics.
+        g = spec.graph
+        self._solo_unit = (
+            g if (self.batcher is None and not g.children
+                  and g.type == UnitType.MODEL
+                  and g.name not in self._hardcoded)
+            else None
+        )
 
     @property
     def all_hardcoded(self) -> bool:
@@ -133,6 +151,30 @@ class PredictorEngine:
         return self._all_hardcoded
 
     @staticmethod
+    def sync_drivable(spec: PredictorSpec, batcher=None) -> bool:
+        """True when an engine built over `spec` with a BLOCKING gRPC
+        client (SyncInternalClient) can serve the sync thread-pool lane:
+        no micro-batcher (its fuse-wait must suspend), no REST-endpoint
+        unit (the blocking client only speaks gRPC), and no multi-child
+        fan-out over network subtrees — those want the async lane's
+        PARALLEL gather (a COMBINER over three 200 ms units must cost
+        ~200 ms, not ~600 ms)."""
+        if batcher is not None:
+            return False
+        for u in spec.graph.walk():
+            if len(u.children) > 1 and any(
+                x.implementation not in HARDCODED_IMPLEMENTATIONS
+                for c in u.children for x in c.walk()
+            ):
+                return False
+            if u.implementation in HARDCODED_IMPLEMENTATIONS:
+                continue
+            ep = u.endpoint
+            if ep is not None and ep.type != EndpointType.GRPC:
+                return False
+        return True
+
+    @staticmethod
     def drive_sync(coro):
         """Run a coroutine that never actually awaits IO to completion on
         the calling thread. Raises RuntimeError if it suspends (a
@@ -143,16 +185,40 @@ class PredictorEngine:
             return e.value
         coro.close()
         raise RuntimeError(
-            "graph walk suspended: predict_sync requires a fully "
-            "in-process (hardcoded, unbatched) graph"
+            "graph walk suspended: predict_sync requires an in-process "
+            "(hardcoded, unbatched) graph or a blocking SyncInternalClient"
         )
 
     def predict_sync(self, request: pb.SeldonMessage,
                      trace_parent=None) -> pb.SeldonMessage:
-        """Synchronous predict for fully in-process graphs — the sync
-        gRPC servicer path (orchestrator/server.py) calls this from
-        worker threads with zero event-loop involvement."""
+        """Synchronous predict for sync-lane graphs (in-process, or over
+        the blocking SyncInternalClient) — the sync gRPC servicer path
+        (orchestrator/server.py) calls this from worker threads with zero
+        event-loop involvement."""
+        if (self._solo_unit is not None and trace_parent is None
+                and not self.tracer.enabled):
+            return self._predict_solo(request)
         return self.drive_sync(self.predict(request, trace_parent))
+
+    def _predict_solo(self, request: pb.SeldonMessage) -> pb.SeldonMessage:
+        """One-network-MODEL fast walk. Produces byte-identical meta to
+        the generic walk: the unit's own tags/metrics survive (absorb +
+        stamp round-trips them), any routing/requestPath a unit tried to
+        inject is dropped (meta.Clear parity), puid + requestPath are
+        engine-stamped."""
+        unit = self._solo_unit
+        puid = request.meta.puid or make_puid()
+        request.meta.puid = puid  # engine owns the request (see predict)
+        out = self.drive_sync(self.client.call(unit, "predict", request))
+        meta = out.meta
+        if self.metrics_hook is not None:
+            for m in meta.metrics:
+                self.metrics_hook(m, unit)
+        meta.puid = puid
+        meta.ClearField("routing")
+        meta.ClearField("requestPath")
+        meta.requestPath[unit.name] = unit.image or unit.name
+        return out
 
     # --- forward path -------------------------------------------------------
 
@@ -183,11 +249,13 @@ class PredictorEngine:
             "engine.predict", parent=trace_parent, attributes={"puid": puid}
         ):
             out = await self._get_output(msg, self.spec.graph, ctx)
-        resp = pb.SeldonMessage()
-        resp.CopyFrom(out)
-        resp.meta.Clear()
-        ctx.stamp(resp.meta)
-        return resp
+        # The engine owns every message on the walk (unit responses are
+        # parsed per-call; hardcoded units build fresh ones), so the
+        # response is stamped IN PLACE — the old copy-into-a-new-message
+        # was a full payload copy per request on the hot path.
+        out.meta.Clear()
+        ctx.stamp(out.meta)
+        return out
 
     async def _get_output(
         self, msg: pb.SeldonMessage, unit: PredictiveUnit, ctx: _RequestCtx
@@ -230,11 +298,11 @@ class PredictorEngine:
             child_outputs = [
                 await self._get_output(transformed, selected[0], ctx)
             ]
-        elif self.all_hardcoded:
-            # Fully in-process graph: children never touch the network, so
-            # sequential awaits complete without suspending — this keeps
-            # the whole predict() coroutine synchronously drivable
-            # (predict_sync) with identical results.
+        elif self._sequential:
+            # In-process graph, or a blocking (sync-lane) client: awaits
+            # complete without suspending either way, so sequential
+            # iteration keeps the whole predict() coroutine synchronously
+            # drivable (predict_sync) with identical results.
             child_outputs = [
                 await self._get_output(transformed, c, ctx) for c in selected
             ]
@@ -357,10 +425,11 @@ class PredictorEngine:
             )
         else:
             children = unit.children
-        if len(children) == 1 or self.all_hardcoded:
+        if len(children) == 1 or self._sequential:
             # Mirrors the predict-path rule: keeps the coroutine
-            # synchronously drivable for in-process graphs (the sync gRPC
-            # servicer) and skips task churn for single-branch mirrors.
+            # synchronously drivable for in-process/sync-lane graphs (the
+            # sync gRPC servicer) and skips task churn for single-branch
+            # mirrors.
             for c in children:
                 await self._send_feedback(feedback, c)
         elif children:

@@ -103,6 +103,22 @@ async def serve(
         grpc_port = grpc_server.add_insecure_port(f"{host}:{grpc_port}")
         grpc_server.start()
         logger.info("gRPC serving on %s:%d", host, grpc_port)
+    fast_server = None
+    if os.environ.get("SELDON_TPU_FASTPATH", "1") != "0":
+        # Framed-proto fast lane on the next port after gRPC — the
+        # engine dials it when the graph declares `fastPort`
+        # (runtime/fastpath.py); harmless to serve when unused.
+        from seldon_tpu.runtime.fastpath import start_fast_server
+
+        base = grpc_port if "GRPC" in api_types else http_port
+        try:
+            fast_server, fast_port = start_fast_server(
+                user_obj, host, base + 1 if base else 0
+            )
+            logger.info("fastpath serving on %s:%d", host, fast_port)
+        except OSError:
+            logger.warning("fastpath port %d unavailable — lane disabled",
+                           base + 1)
     if ready_event is not None:
         ready_event.ports = (http_port, grpc_port)
         ready_event.set()
@@ -116,6 +132,8 @@ async def serve(
             await r.cleanup()
         if grpc_server is not None:
             grpc_server.stop(grace=1)
+        if fast_server is not None:
+            fast_server.shutdown()
 
 
 def main(argv=None):
@@ -140,8 +158,9 @@ def main(argv=None):
     parser.add_argument("interface_name", help="user class (Module.Class)")
     parser.add_argument(
         "--api-type",
-        default="REST,GRPC",
-        help="comma-separated: REST, GRPC (default both)",
+        default=os.environ.get("API_TYPE", "REST,GRPC"),
+        help="comma-separated: REST, GRPC (default both; env API_TYPE — "
+             "the s2i-parity contract the operator pins per endpoint type)",
     )
     parser.add_argument(
         "--service-type",

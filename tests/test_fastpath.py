@@ -1,0 +1,269 @@
+"""Fast-path unit transport (runtime/fastpath.py) + the sync-lane engine
+that rides it: framing, error paths, reconnects, and meta parity between
+the solo fast walk and the generic async walk."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_tpu.core import payloads
+from seldon_tpu.proto import prediction_pb2 as pb
+from seldon_tpu.runtime.fastpath import FastClient, start_fast_server
+
+
+class EchoTags:
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def tags(self):
+        return {"arm": "echo"}
+
+    def metrics(self):
+        return [{"type": "COUNTER", "key": "echo_calls", "value": 1}]
+
+
+class Boom:
+    def predict(self, X, names, meta=None):
+        raise ValueError("boom payload")
+
+
+@pytest.fixture(scope="module")
+def fast_server():
+    srv, port = start_fast_server(EchoTags(), "127.0.0.1", 0)
+    yield port
+    srv.shutdown()
+
+
+def _req(rows):
+    return payloads.build_message(np.asarray(rows, np.float64),
+                                  names=["a", "b"], kind="ndarray")
+
+
+def test_fastpath_roundtrip(fast_server):
+    c = FastClient()
+    out = c.call("127.0.0.1", fast_server, "predict", _req([[1.0, 2.0]]))
+    arr, _, _, _ = payloads.extract_request_parts(out)
+    np.testing.assert_allclose(np.asarray(arr), [[2.0, 4.0]])
+    # User tags/metrics ride meta like every other transport.
+    assert out.meta.tags["arm"].string_value == "echo"
+    assert out.meta.metrics[0].key == "echo_calls"
+    c.close()
+
+
+def test_fastpath_persistent_socket_many_calls(fast_server):
+    c = FastClient()
+    for i in range(20):
+        out = c.call("127.0.0.1", fast_server, "predict",
+                     _req([[float(i), 1.0]]))
+        arr, _, _, _ = payloads.extract_request_parts(out)
+        assert np.asarray(arr)[0][0] == 2.0 * i
+    c.close()
+
+
+def test_fastpath_unit_error_is_framed():
+    srv, port = start_fast_server(Boom(), "127.0.0.1", 0)
+    try:
+        c = FastClient()
+        with pytest.raises(RuntimeError, match="boom payload"):
+            c.call("127.0.0.1", port, "predict", _req([[1.0, 2.0]]))
+        # The connection survives a unit error (framed, not fatal).
+        with pytest.raises(RuntimeError, match="boom payload"):
+            c.call("127.0.0.1", port, "predict", _req([[1.0, 2.0]]))
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_fastpath_reconnect_after_server_restart():
+    srv, port = start_fast_server(EchoTags(), "127.0.0.1", 0)
+    c = FastClient()
+    c.call("127.0.0.1", port, "predict", _req([[1.0, 2.0]]))
+    srv.shutdown()
+    srv.server_close()
+    srv2, port2 = start_fast_server(EchoTags(), "127.0.0.1", port)
+    try:
+        # The stale persistent socket raises ConnectionError (the engine
+        # client retries and reconnects); a fresh call then succeeds.
+        try:
+            c.call("127.0.0.1", port, "predict", _req([[1.0, 2.0]]))
+        except (ConnectionError, OSError):
+            pass
+        out = c.call("127.0.0.1", port2, "predict", _req([[1.0, 2.0]]))
+        arr, _, _, _ = payloads.extract_request_parts(out)
+        np.testing.assert_allclose(np.asarray(arr), [[2.0, 4.0]])
+    finally:
+        c.close()
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_fastpath_threaded_clients(fast_server):
+    """Per-thread sockets: concurrent callers never share a connection."""
+    c = FastClient()
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(10):
+                out = c.call("127.0.0.1", fast_server, "predict",
+                             _req([[float(i), 0.0]]))
+                arr, _, _, _ = payloads.extract_request_parts(out)
+                assert np.asarray(arr)[0][0] == 2.0 * i
+        except Exception as e:  # surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join(30) for t in ts]
+    assert not errs, errs
+
+
+# ---------------------------------------------------------------------------
+# Sync-lane engine over a network unit
+# ---------------------------------------------------------------------------
+
+
+def _engine_server_with_unit(fast: bool):
+    from seldon_tpu.orchestrator.server import EngineServer
+    from seldon_tpu.orchestrator.spec import (
+        Endpoint, PredictiveUnit, PredictorSpec,
+    )
+    from seldon_tpu.runtime.wrapper import build_grpc_server
+
+    model = EchoTags()
+    gsrv = build_grpc_server(model)
+    gport = gsrv.add_insecure_port("127.0.0.1:0")
+    gsrv.start()
+    fsrv, fport = start_fast_server(model, "127.0.0.1", 0)
+    spec = PredictorSpec(
+        name="p",
+        graph=PredictiveUnit(
+            name="echo", type="MODEL",
+            endpoint=Endpoint(service_host="127.0.0.1", service_port=gport,
+                              fast_port=fport if fast else 0),
+        ),
+    )
+    es = EngineServer(spec=spec, http_port=0, grpc_port=0,
+                      enable_batching=False)
+    return es, (gsrv, fsrv)
+
+
+@pytest.mark.parametrize("fast", [True, False])
+def test_sync_lane_serves_network_unit(fast):
+    """The sync thread-pool gRPC lane now covers network-unit graphs
+    (round-5: SyncInternalClient); response meta matches the contract
+    (puid, requestPath, unit tags)."""
+    import asyncio
+
+    import grpc
+
+    from seldon_tpu.proto import prediction_grpc
+
+    es, servers = _engine_server_with_unit(fast)
+    assert es.engine_sync is not None, "graph should be sync-drivable"
+
+    holder, started = {}, threading.Event()
+
+    async def amain():
+        await es.start(host="127.0.0.1")
+        holder["grpc"] = es.grpc_port
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await es.stop()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    assert started.wait(30)
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{holder['grpc']}")
+        stub = prediction_grpc.SeldonStub(ch)
+        out = stub.Predict(_req([[1.0, 2.0]]), timeout=30)
+        arr, _, _, _ = payloads.extract_request_parts(out)
+        np.testing.assert_allclose(np.asarray(arr), [[2.0, 4.0]])
+        assert out.meta.puid
+        assert out.meta.requestPath["echo"] == "echo"
+        assert out.meta.tags["arm"].string_value == "echo"
+        ch.close()
+    finally:
+        holder["stop"] = True
+        t.join(timeout=15)
+        for s in servers:
+            try:
+                s.stop(grace=0.2)
+            except (AttributeError, TypeError):
+                s.shutdown()
+
+
+def test_fast_lane_falls_back_when_port_refused():
+    """A declared fastPort nobody serves (unit image without the lane)
+    must not fail the graph: the sync client falls back to gRPC for
+    good after the first refused connect."""
+    from seldon_tpu.orchestrator.client import SyncInternalClient
+    from seldon_tpu.orchestrator.spec import Endpoint, PredictiveUnit
+    from seldon_tpu.runtime.wrapper import build_grpc_server
+
+    gsrv = build_grpc_server(EchoTags())
+    gport = gsrv.add_insecure_port("127.0.0.1:0")
+    gsrv.start()
+    # Claim a port and close it: connects there are REFUSED.
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    unit = PredictiveUnit(
+        name="echo", type="MODEL",
+        endpoint=Endpoint(service_host="127.0.0.1", service_port=gport,
+                          fast_port=dead_port),
+    )
+    c = SyncInternalClient(retries=1)
+    try:
+        coro = c.call(unit, "predict", _req([[1.0, 2.0]]))
+        # drive the never-suspending coroutine without a loop
+        try:
+            coro.send(None)
+            raise AssertionError("sync client call suspended")
+        except StopIteration as e:
+            out = e.value
+        arr, _, _, _ = payloads.extract_request_parts(out)
+        np.testing.assert_allclose(np.asarray(arr), [[2.0, 4.0]])
+        assert dead_port in c._fast_dead
+    finally:
+        gsrv.stop(grace=0.2)
+
+
+def test_solo_fast_walk_meta_parity():
+    """predict_sync's solo fast walk returns the same meta as the generic
+    async walk for the same graph + request."""
+    import asyncio
+
+    es, servers = _engine_server_with_unit(True)
+    try:
+        eng_async, eng_sync = es.engine, es.engine_sync
+        assert eng_sync._solo_unit is not None
+
+        req1 = _req([[1.0, 2.0]])
+        req1.meta.puid = "fixed-puid"
+        req2 = pb.SeldonMessage()
+        req2.CopyFrom(req1)
+
+        out_async = asyncio.run(eng_async.predict(req1))
+        out_sync = eng_sync.predict_sync(req2)
+        assert out_sync.meta.puid == out_async.meta.puid == "fixed-puid"
+        assert dict(out_sync.meta.requestPath) == dict(
+            out_async.meta.requestPath)
+        assert (out_sync.meta.tags["arm"].string_value
+                == out_async.meta.tags["arm"].string_value)
+        assert ([m.key for m in out_sync.meta.metrics]
+                == [m.key for m in out_async.meta.metrics])
+        asyncio.run(eng_async.close())
+        asyncio.run(eng_sync.close())
+    finally:
+        for s in servers:
+            try:
+                s.stop(grace=0.2)
+            except (AttributeError, TypeError):
+                s.shutdown()

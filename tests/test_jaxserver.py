@@ -132,6 +132,56 @@ def test_jaxserver_generate_stream(server):
     assert chunks[0]["ttft_ms"] > 0
 
 
+def test_loadtester_generate_against_live_server(server, capsys):
+    """`loadtester --transport generate` driven at a LIVE /generate
+    endpoint (the tiny JAXServer fixture behind the real REST app):
+    tokens/s and completion accounting must be sane."""
+    import asyncio
+    import json as _json
+    import threading
+
+    from aiohttp import web
+
+    from seldon_tpu.loadtester import main as lt_main
+    from seldon_tpu.runtime.wrapper import build_rest_app
+
+    holder, started = {}, threading.Event()
+
+    async def amain():
+        runner = web.AppRunner(build_rest_app(server))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    assert started.wait(30)
+    try:
+        lt_main([
+            f"http://127.0.0.1:{holder['port']}", "--transport", "generate",
+            "--clients", "2", "--seconds", "2", "--prompt", "hi",
+            "--max-new-tokens", "4",
+        ])
+    finally:
+        holder["stop"] = True
+        t.join(timeout=10)
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "loadtest_generate_req_per_s"
+    assert out["value"] > 0
+    d = out["detail"]
+    assert d["errors"] == 0
+    # Closed-loop accounting: every completed request produced >= 1 and
+    # <= max_new_tokens tokens.
+    assert d["requests"] >= 1
+    assert d["requests"] <= d["completion_tokens"] <= 4 * d["requests"]
+    assert d["tokens_per_s"] > 0
+
+
 def test_jaxserver_predict_scores(server):
     scores = server.predict(np.array([[3, 4, 5, 6]]), [])
     assert scores.shape == (1,)

@@ -65,6 +65,44 @@ def test_defaulting_assigns_ports_and_hosts():
     assert unit.image == T.DEFAULT_SERVER_IMAGE
 
 
+def test_defaulting_fastpath_ports_and_stride():
+    """Native units get fastPort = service_port+1; allocation strides by
+    2 so the fast lane never collides with the next unit; foreign images
+    stay off the lane unless the annotation opts them in."""
+    sdep = fixture_cr(predictors=[{
+        "name": "main", "replicas": 1,
+        "graph": {
+            "name": "t", "type": "TRANSFORMER",
+            "image": "seldon-tpu/microservice:0.1.0",
+            "children": [{
+                "name": "m", "type": "MODEL",
+                "image": "other-registry/foreign:1",
+            }],
+        },
+    }])
+    default_deployment(sdep)
+    units = {u.name: u for u in sdep.predictors[0].spec.graph.walk()}
+    assert units["t"].endpoint.service_port == 9000
+    assert units["t"].endpoint.fast_port == 9001
+    assert units["m"].endpoint.service_port == 9002  # stride 2
+    assert units["m"].endpoint.fast_port == 0  # foreign image: no lane
+
+    sdep2 = fixture_cr(predictors=[{
+        "name": "main", "replicas": 1,
+        "graph": {"name": "m", "type": "MODEL",
+                  "image": "other-registry/foreign:1"},
+    }])
+    sdep2.annotations[T.ANNOTATION_FASTPATH] = "true"
+    default_deployment(sdep2)
+    assert sdep2.predictors[0].spec.graph.endpoint.fast_port == 9001
+
+    # fastPort survives the round trip into the engine's spec encoding.
+    from seldon_tpu.orchestrator.spec import PredictiveUnit as PU
+
+    rt = PU.from_dict(sdep.predictors[0].spec.graph.to_dict())
+    assert rt.endpoint.fast_port == 9001
+
+
 def test_defaulting_separate_engine_uses_svc_dns():
     sdep = fixture_cr()
     sdep.annotations[T.ANNOTATION_SEPARATE_ENGINE] = "true"
