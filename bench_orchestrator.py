@@ -39,6 +39,7 @@ import numpy as np
 CLIENTS = int(os.environ.get("BENCH_ORCH_CLIENTS", "32"))
 CLIENT_PROCS = int(os.environ.get("BENCH_ORCH_CLIENT_PROCS", "2"))
 SECONDS = float(os.environ.get("BENCH_ORCH_SECONDS", "12"))  # 5s windows are too noisy on small boxes
+REPEATS = max(1, int(os.environ.get("BENCH_ORCH_REPEATS", "3")))
 TRANSPORTS = os.environ.get("BENCH_ORCH_TRANSPORTS", "rest,grpc").split(",")
 PAYLOADS = os.environ.get("BENCH_ORCH_PAYLOADS", "ndarray,dense").split(",")
 # inproc = hardcoded SIMPLE_MODEL (sync gRPC lane, the reference's own
@@ -260,6 +261,13 @@ def run_clients(transport, port, kind, seconds, clients):
              transport, str(port), kind, str(seconds), str(per)],
             stdout=subprocess.PIPE,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            # De-prioritize the load generators: on a 1-core box they
+            # otherwise preempt server threads mid-handler and inflate
+            # the server's CPU/req with involuntary context switches —
+            # the reference's rig had clients on separate NODES; this is
+            # the single-box approximation. Closed-loop clients still
+            # saturate the server (it runs whenever work is pending).
+            preexec_fn=lambda: os.nice(5),
         )
         for _ in range(CLIENT_PROCS)
     ]
@@ -310,12 +318,22 @@ async def run_scenario(graph: str):
         for transport in TRANSPORTS:
             for kind in PAYLOADS:
                 run(transport, kind, 0.5, 8)  # settle + warm
-                cpu0 = server_cpu_seconds(proc.pid)
-                total, dt, p50, p99 = run(transport, kind, SECONDS, CLIENTS)
-                cpu1 = server_cpu_seconds(proc.pid)
+                # Median of REPEATS windows: single windows on a 1-core
+                # box swing +/-30% with scheduler luck; the median is the
+                # recorded row (all trials ride identical config).
+                trials = []
+                for _ in range(REPEATS):
+                    cpu0 = server_cpu_seconds(proc.pid)
+                    total, dt, p50, p99 = run(
+                        transport, kind, SECONDS, CLIENTS
+                    )
+                    cpu1 = server_cpu_seconds(proc.pid)
+                    trials.append((total, dt, p50, p99, cpu1 - cpu0))
+                trials.sort(key=lambda t: t[0] / t[4] if t[4] else 0)
+                total, dt, p50, p99, cpu_s = trials[len(trials) // 2]
                 report(
                     f"engine_{transport}{suffix}_req_per_s_per_core", kind,
-                    total, dt, p50, p99, cpu1 - cpu0,
+                    total, dt, p50, p99, cpu_s,
                     REF_PER_CORE[transport],
                 )
     finally:

@@ -270,6 +270,16 @@ class InternalClient:
                 ) from e
 
 
+def _unit_name_of(identity: tuple, ep: Endpoint) -> str:
+    """Unit name from the cached identity metadata (seldon-model-name);
+    the endpoint host only as a last resort — failures must attribute to
+    the UNIT, consistently across lanes."""
+    for k, v in identity:
+        if k == "seldon-model-name" and v:
+            return v
+    return ep.service_host
+
+
 class SyncInternalClient(InternalClient):
     """BLOCKING gRPC variant for the sync servicer lane.
 
@@ -304,9 +314,10 @@ class SyncInternalClient(InternalClient):
 
     async def _call_grpc(self, ep: Endpoint, method: str, request,
                          identity: tuple = ()):
+        fast_key = (ep.service_host, ep.fast_port)
         use_fast = (
             ep.fast_port
-            and ep.fast_port not in self._fast_dead
+            and fast_key not in self._fast_dead
             # The frame carries no metadata: traced requests ride full
             # gRPC so the traceparent + identity headers reach the unit.
             and tracing._current_span.get() is None
@@ -325,21 +336,25 @@ class SyncInternalClient(InternalClient):
                 out = self._fast.call(
                     ep.service_host, ep.fast_port, method, request
                 )
-                self._fast_errs.pop(ep.fast_port, None)
+                self._fast_errs.pop(fast_key, None)
                 return out
             except RuntimeError as e:
-                raise UnitCallError(ep.service_host, method, str(e)) from e
+                # Framed unit error: attribute it to the UNIT like every
+                # other lane (identity carries seldon-model-name).
+                raise UnitCallError(
+                    _unit_name_of(identity, ep), method, str(e)
+                ) from e
             except ConnectionRefusedError:
-                self._fast_dead.add(ep.fast_port)
+                self._fast_dead.add(fast_key)
                 logger.warning(
                     "fastPort %d refused on %s — falling back to gRPC",
                     ep.fast_port, ep.service_host,
                 )
             except (ConnectionError, OSError):
-                n = self._fast_errs.get(ep.fast_port, 0) + 1
-                self._fast_errs[ep.fast_port] = n
+                n = self._fast_errs.get(fast_key, 0) + 1
+                self._fast_errs[fast_key] = n
                 if n >= 3:
-                    self._fast_dead.add(ep.fast_port)
+                    self._fast_dead.add(fast_key)
                     logger.warning(
                         "fastPort %d failed %d consecutive transports on "
                         "%s — falling back to gRPC",
@@ -364,7 +379,7 @@ class SyncInternalClient(InternalClient):
     async def _call_rest(self, ep: Endpoint, method: str, request,
                          response_cls, identity: tuple = ()):
         raise UnitCallError(
-            ep.service_host, method,
+            _unit_name_of(identity, ep), method,
             "REST unit on the sync lane (sync_drivable should have "
             "excluded this graph)",
         )

@@ -337,12 +337,14 @@ class EngineServer:
 
             self._grpc_server = grpc.server(
                 futures.ThreadPoolExecutor(
-                    # 16 measured best on the netunit bench: with a
-                    # blocking unit hop in the handler, deeper in-flight
-                    # amortizes poller wakeups (8 -> 1.56x, 16 -> 2.06x,
-                    # 32+ thrashes); in-process graphs are insensitive.
+                    # 8 measured best on the netunit bench once the solo
+                    # fast walk shrank per-handler python time (15 s
+                    # windows: 8 -> 2.6x, 12 -> 2.1x per engine core);
+                    # more workers just convoy on the GIL. Blocking unit
+                    # hops release the GIL, so 8 still overlaps plenty of
+                    # in-flight requests.
                     max_workers=int(
-                        os.environ.get("SELDON_TPU_GRPC_WORKERS", "16")
+                        os.environ.get("SELDON_TPU_GRPC_WORKERS", "8")
                     )
                 ),
                 options=grpc_options,

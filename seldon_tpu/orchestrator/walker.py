@@ -155,17 +155,20 @@ class PredictorEngine:
         """True when an engine built over `spec` with a BLOCKING gRPC
         client (SyncInternalClient) can serve the sync thread-pool lane:
         no micro-batcher (its fuse-wait must suspend), no REST-endpoint
-        unit (the blocking client only speaks gRPC), and no multi-child
-        fan-out over network subtrees — those want the async lane's
-        PARALLEL gather (a COMBINER over three 200 ms units must cost
-        ~200 ms, not ~600 ms)."""
+        unit (the blocking client only speaks gRPC), and no COMBINER
+        fan-out over network subtrees — a combiner calls ALL children
+        per request and wants the async lane's PARALLEL gather (three
+        200 ms units must cost ~200 ms, not ~600 ms). ROUTER graphs stay
+        sync-drivable: a routed request walks exactly one branch (a rare
+        broadcast route of -1 runs its branches sequentially)."""
         if batcher is not None:
             return False
         for u in spec.graph.walk():
-            if len(u.children) > 1 and any(
-                x.implementation not in HARDCODED_IMPLEMENTATIONS
-                for c in u.children for x in c.walk()
-            ):
+            if (u.type == UnitType.COMBINER and len(u.children) > 1
+                    and any(
+                        x.implementation not in HARDCODED_IMPLEMENTATIONS
+                        for c in u.children for x in c.walk()
+                    )):
                 return False
             if u.implementation in HARDCODED_IMPLEMENTATIONS:
                 continue

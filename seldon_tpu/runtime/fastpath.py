@@ -36,6 +36,12 @@ from seldon_tpu.proto import prediction_pb2 as pb
 
 logger = logging.getLogger(__name__)
 
+# Both directions refuse frames beyond this (the gRPC lane's
+# grpc.max_receive_message_length equivalent): the 4-byte length field is
+# peer-controlled, and an unbounded read lets a misdialed/foreign peer
+# drive a multi-GiB allocation.
+MAX_FRAME_BYTES = 512 * 1024 * 1024
+
 # Wire method ids — order is part of the protocol; append only.
 METHODS = (
     "predict",
@@ -76,6 +82,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     return  # clean close between frames
                 mid = hdr[0]
                 n = int.from_bytes(hdr[1:5], "big")
+                if n > MAX_FRAME_BYTES:
+                    logger.warning("fastpath frame of %d bytes refused", n)
+                    return  # close: peer is broken or not speaking this
                 body = _read_exact(f, n)
                 try:
                     name = METHODS[mid]
@@ -153,7 +162,13 @@ class FastClient:
         try:
             s.sendall(frame)
             hdr = _recv_exact(s, 5)
-            payload = _recv_exact(s, int.from_bytes(hdr[1:5], "big"))
+            n = int.from_bytes(hdr[1:5], "big")
+            if n > MAX_FRAME_BYTES:
+                # A foreign server's bytes misread as a frame header must
+                # not drive an allocation; surface as a transport error
+                # (the engine's fallback machinery handles it).
+                raise ConnectionError(f"fastpath frame of {n} bytes refused")
+            payload = _recv_exact(s, n)
         except (OSError, ConnectionError):
             self._drop(addr)
             raise

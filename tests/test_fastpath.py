@@ -230,9 +230,45 @@ def test_fast_lane_falls_back_when_port_refused():
             out = e.value
         arr, _, _, _ = payloads.extract_request_parts(out)
         np.testing.assert_allclose(np.asarray(arr), [[2.0, 4.0]])
-        assert dead_port in c._fast_dead
+        assert ("127.0.0.1", dead_port) in c._fast_dead
     finally:
         gsrv.stop(grace=0.2)
+
+
+def test_sync_drivable_classification():
+    """Router fan-outs ride the sync lane (one branch per request);
+    COMBINER fan-outs over network children need the async gather."""
+    from seldon_tpu.orchestrator.spec import (
+        Endpoint, PredictiveUnit, PredictorSpec,
+    )
+    from seldon_tpu.orchestrator.walker import PredictorEngine
+
+    def net(name):
+        return PredictiveUnit(name=name, type="MODEL",
+                              endpoint=Endpoint(service_port=9000))
+
+    router = PredictorSpec(name="p", graph=PredictiveUnit(
+        name="r", type="ROUTER", endpoint=Endpoint(service_port=9004),
+        children=[net("a"), net("b")],
+    ))
+    assert PredictorEngine.sync_drivable(router)
+
+    combiner = PredictorSpec(name="p", graph=PredictiveUnit(
+        name="c", type="COMBINER", endpoint=Endpoint(service_port=9004),
+        children=[net("a"), net("b")],
+    ))
+    assert not PredictorEngine.sync_drivable(combiner)
+
+    hardcoded_combiner = PredictorSpec(name="p", graph=PredictiveUnit(
+        name="c", type="COMBINER", implementation="AVERAGE_COMBINER",
+        children=[
+            PredictiveUnit(name="a", type="MODEL",
+                           implementation="SIMPLE_MODEL"),
+            PredictiveUnit(name="b", type="MODEL",
+                           implementation="SIMPLE_MODEL"),
+        ],
+    ))
+    assert PredictorEngine.sync_drivable(hardcoded_combiner)
 
 
 def test_solo_fast_walk_meta_parity():
