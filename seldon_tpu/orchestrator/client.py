@@ -105,6 +105,12 @@ class InternalClient:
         self._rpcs: Dict[tuple, object] = {}
         self._unit_metadata: Dict[str, tuple] = {}
         self._rest_static: Dict[tuple, tuple] = {}
+        # Framed-proto fast-lane state (runtime/fastpath.py), shared by
+        # the async and sync variants: endpoints that refused or
+        # repeatedly failed the lane fall back to gRPC for good.
+        self._fast_dead: set = set()
+        self._fast_errs: Dict[tuple, int] = {}
+        self._afast = None  # lazy AsyncFastClient
 
     # --- transport plumbing -------------------------------------------------
 
@@ -131,6 +137,9 @@ class InternalClient:
         if self._http is not None:
             await self._http.close()
             self._http = None
+        if self._afast is not None:
+            await self._afast.close()
+            self._afast = None
 
     # --- calls --------------------------------------------------------------
 
@@ -196,8 +205,63 @@ class InternalClient:
             self._rpcs[key] = rpc
         return rpc
 
+    def _fast_usable(self, ep: Endpoint) -> bool:
+        """Fast lane applies when the endpoint declares it, it hasn't
+        been written off, and the request is untraced (the frame carries
+        no metadata — traced requests ride full gRPC so traceparent +
+        identity headers reach the unit)."""
+        return bool(
+            ep.fast_port
+            and (ep.service_host, ep.fast_port) not in self._fast_dead
+            and tracing._current_span.get() is None
+        )
+
+    def _fast_fail(self, ep: Endpoint, refused: bool) -> None:
+        key = (ep.service_host, ep.fast_port)
+        if refused:
+            self._fast_dead.add(key)
+            logger.warning(
+                "fastPort %d refused on %s — falling back to gRPC",
+                ep.fast_port, ep.service_host,
+            )
+            return
+        n = self._fast_errs.get(key, 0) + 1
+        self._fast_errs[key] = n
+        if n >= 3:
+            # e.g. the port is actually some OTHER server that accepts
+            # and then drops the framed bytes: connect never refuses, so
+            # repeated transport failures are the write-off signal.
+            self._fast_dead.add(key)
+            logger.warning(
+                "fastPort %d failed %d consecutive transports on %s — "
+                "falling back to gRPC",
+                ep.fast_port, n, ep.service_host,
+            )
+
     async def _call_grpc(self, ep: Endpoint, method: str, request,
                          identity: tuple = ()):
+        if self._fast_usable(ep):
+            if self._afast is None:
+                from seldon_tpu.runtime.fastpath import AsyncFastClient
+
+                self._afast = AsyncFastClient(timeout_s=self.timeout_s)
+            try:
+                out = await self._afast.call(
+                    ep.service_host, ep.fast_port, method, request
+                )
+                self._fast_errs.pop((ep.service_host, ep.fast_port), None)
+                return out
+            except RuntimeError as e:
+                raise UnitCallError(
+                    _unit_name_of(identity, ep), method, str(e)
+                ) from e
+            except ConnectionRefusedError:
+                self._fast_fail(ep, refused=True)
+            except TimeoutError:
+                raise  # slow unit, not a broken lane: no write-off count
+            except (ConnectionError, OSError):
+                self._fast_fail(ep, refused=False)
+                raise  # retryable in call(); next attempt may fall back
         rpc = self._rpc(ep, method)
         cur = tracing._current_span.get()
         if cur is None:  # tracing off: the static per-unit tuple as-is
@@ -301,8 +365,6 @@ class SyncInternalClient(InternalClient):
         from seldon_tpu.runtime.fastpath import FastClient
 
         self._fast = FastClient(timeout_s=self.timeout_s)
-        self._fast_dead: set = set()  # fastPorts that refused: use gRPC
-        self._fast_errs: Dict[int, int] = {}  # consecutive transport errs
 
     def _channel(self, endpoint: Endpoint):
         addr = f"{endpoint.service_host}:{endpoint.service_port}"
@@ -314,52 +376,28 @@ class SyncInternalClient(InternalClient):
 
     async def _call_grpc(self, ep: Endpoint, method: str, request,
                          identity: tuple = ()):
-        fast_key = (ep.service_host, ep.fast_port)
-        use_fast = (
-            ep.fast_port
-            and fast_key not in self._fast_dead
-            # The frame carries no metadata: traced requests ride full
-            # gRPC so the traceparent + identity headers reach the unit.
-            and tracing._current_span.get() is None
-        )
-        if use_fast:
-            # Framed-proto fast lane (runtime/fastpath.py): one
-            # sendall+recv on a persistent per-thread socket instead of a
-            # full gRPC round trip. ConnectionError is retryable in
-            # call() (reconnects transparently); a framed unit error is a
-            # unit failure; a REFUSED connect — or repeated transport
-            # failures (e.g. the port is actually some OTHER server that
-            # accepts and then drops the framed bytes) — means the lane
-            # is wrong for this unit: fall back to gRPC for good rather
-            # than failing a correct graph.
+        if self._fast_usable(ep):
+            # Blocking fast lane: one sendall+recv on a persistent
+            # per-thread socket instead of a full gRPC round trip.
+            # ConnectionError is retryable in call() (reconnects
+            # transparently); a framed unit error is a unit failure;
+            # refused/repeated failures write the lane off (_fast_fail).
             try:
                 out = self._fast.call(
                     ep.service_host, ep.fast_port, method, request
                 )
-                self._fast_errs.pop(fast_key, None)
+                self._fast_errs.pop((ep.service_host, ep.fast_port), None)
                 return out
             except RuntimeError as e:
-                # Framed unit error: attribute it to the UNIT like every
-                # other lane (identity carries seldon-model-name).
                 raise UnitCallError(
                     _unit_name_of(identity, ep), method, str(e)
                 ) from e
             except ConnectionRefusedError:
-                self._fast_dead.add(fast_key)
-                logger.warning(
-                    "fastPort %d refused on %s — falling back to gRPC",
-                    ep.fast_port, ep.service_host,
-                )
+                self._fast_fail(ep, refused=True)
+            except TimeoutError:
+                raise  # slow unit, not a broken lane: no write-off count
             except (ConnectionError, OSError):
-                n = self._fast_errs.get(fast_key, 0) + 1
-                self._fast_errs[fast_key] = n
-                if n >= 3:
-                    self._fast_dead.add(fast_key)
-                    logger.warning(
-                        "fastPort %d failed %d consecutive transports on "
-                        "%s — falling back to gRPC",
-                        ep.fast_port, n, ep.service_host,
-                    )
+                self._fast_fail(ep, refused=False)
                 raise  # retryable in call(); next attempt may fall back
         rpc = self._rpc(ep, method)
         cur = tracing._current_span.get()

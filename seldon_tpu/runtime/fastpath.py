@@ -59,6 +59,26 @@ _REQUEST_CLS = {
 }
 
 
+def _close_raw(raw) -> None:
+    """Release a transport's underlying fd directly — get_extra_info
+    returns a TransportSocket wrapper (no close()), and writer.close()
+    raises once the owning loop is gone."""
+    import os
+
+    try:
+        if raw is not None:
+            os.close(raw.fileno())
+    except OSError:
+        pass
+
+
+def _build_frame(method: str, request) -> bytes:
+    """Request frame: [method id][4-byte BE length][payload] — the one
+    definition both client lanes share."""
+    body = request.SerializeToString()
+    return bytes([METHOD_ID[method]]) + len(body).to_bytes(4, "big") + body
+
+
 def _read_exact(f, n: int) -> bytes:
     buf = f.read(n)
     if buf is None or len(buf) < n:
@@ -155,9 +175,7 @@ class FastClient:
         failure (caller retries / falls back) and RuntimeError with the
         unit's detail on a framed unit error."""
         addr = (host, port)
-        body = request.SerializeToString()
-        frame = (bytes([METHOD_ID[method]])
-                 + len(body).to_bytes(4, "big") + body)
+        frame = _build_frame(method, request)
         s = self._sock(addr)
         try:
             s.sendall(frame)
@@ -187,6 +205,96 @@ class FastClient:
                 except OSError:
                     pass
             pool.clear()
+
+
+class AsyncFastClient:
+    """asyncio-native fast-path client: a small pool of persistent
+    stream connections per (loop, endpoint) — concurrent callers each
+    check one out, so a connection never interleaves two frames.
+
+    Timeout policy matches the gRPC lane: a TIMED-OUT call raises
+    TimeoutError (never retried upstream — the unit may already be doing
+    the work) and its connection is dropped; only transport breaks
+    (peer closed, refused) surface as retryable ConnectionError."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        import collections
+
+        self.timeout_s = timeout_s
+        # {loop: {(host, port): deque[(reader, writer, raw_sock)]}} —
+        # keyed by the loop OBJECT (an id() would be reusable after GC
+        # and could hand a new loop a dead connection); closed loops are
+        # pruned on the next call and their raw fds released directly
+        # (writer.close() on a dead loop raises).
+        self._pools: Dict[object, Dict[Tuple[str, int], object]] = {}
+        self._deque = collections.deque
+
+    def _pool(self, loop, addr):
+        for lp in list(self._pools):
+            if lp.is_closed() and lp is not loop:
+                for dq in self._pools.pop(lp).values():
+                    while dq:
+                        _, _, raw = dq.pop()
+                        _close_raw(raw)
+        by_addr = self._pools.setdefault(loop, {})
+        dq = by_addr.get(addr)
+        if dq is None:
+            dq = by_addr[addr] = self._deque()
+        return dq
+
+    async def call(self, host: str, port: int, method: str, request,
+                   response_cls=pb.SeldonMessage):
+        import asyncio
+
+        pool = self._pool(asyncio.get_running_loop(), (host, port))
+        frame = _build_frame(method, request)
+        if pool:
+            reader, writer, raw = pool.pop()
+        else:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), self.timeout_s)
+            raw = writer.get_extra_info("socket")
+            if raw is not None:
+                raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            writer.write(frame)
+            # drain() bounded too: a peer that stops reading must not
+            # hang the request forever on a full transport buffer.
+            await asyncio.wait_for(writer.drain(), self.timeout_s)
+            hdr = await asyncio.wait_for(
+                reader.readexactly(5), self.timeout_s)
+            n = int.from_bytes(hdr[1:5], "big")
+            if n > MAX_FRAME_BYTES:
+                raise ConnectionError(
+                    f"fastpath frame of {n} bytes refused")
+            payload = await asyncio.wait_for(
+                reader.readexactly(n), self.timeout_s)
+        except asyncio.IncompleteReadError as e:
+            writer.close()
+            raise ConnectionError(str(e)) from e
+        except TimeoutError:  # mid-frame state: connection unusable,
+            writer.close()    # but the CALL must not be retried
+            raise
+        except (OSError, ConnectionError):
+            writer.close()
+            raise
+        pool.append((reader, writer, raw))
+        if hdr[0] != 0:
+            raise RuntimeError(payload.decode("utf-8", "replace"))
+        out = response_cls()
+        out.ParseFromString(payload)
+        return out
+
+    async def close(self) -> None:
+        for by_addr in self._pools.values():
+            for dq in by_addr.values():
+                while dq:
+                    _, writer, raw = dq.pop()
+                    try:
+                        writer.close()
+                    except RuntimeError:  # connection's loop closed
+                        _close_raw(raw)
+        self._pools.clear()
 
 
 def _recv_exact(s: socket.socket, n: int) -> bytes:

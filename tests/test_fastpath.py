@@ -287,6 +287,9 @@ def test_solo_fast_walk_meta_parity():
         req2.CopyFrom(req1)
 
         out_async = asyncio.run(eng_async.predict(req1))
+        # The async lane rode the fast transport too (AsyncFastClient
+        # is built lazily on first fast-lane use).
+        assert eng_async.client._afast is not None
         out_sync = eng_sync.predict_sync(req2)
         assert out_sync.meta.puid == out_async.meta.puid == "fixed-puid"
         assert dict(out_sync.meta.requestPath) == dict(
