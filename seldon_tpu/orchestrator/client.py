@@ -238,30 +238,57 @@ class InternalClient:
                 ep.fast_port, n, ep.service_host,
             )
 
+    async def _fast_transport(self, ep: Endpoint, method: str, request):
+        """The lane's transport call — the ONLY thing the sync variant
+        overrides; error policy lives once in _fast_attempt."""
+        if self._afast is None:
+            from seldon_tpu.runtime.fastpath import AsyncFastClient
+
+            self._afast = AsyncFastClient(timeout_s=self.timeout_s)
+        return await self._afast.call(
+            ep.service_host, ep.fast_port, method, request
+        )
+
+    async def _fast_attempt(self, ep: Endpoint, method: str, request,
+                            identity: tuple):
+        """One fast-lane attempt. Returns (handled, out); handled False
+        means fall through to gRPC for this call. Error policy:
+        - framed unit error -> UnitCallError (attributed to the unit)
+        - refused connect -> permanent gRPC fallback, handled False
+        - stale pooled connection died -> retryable, NOT counted toward
+          the write-off (the unit just restarted; a fresh connect works)
+        - timeout -> not retried, not counted (slow unit, healthy lane)
+        - fresh-connection transport failure -> counted; 3 in a row
+          write the lane off."""
+        from seldon_tpu.runtime.fastpath import StaleConnection
+
+        try:
+            out = await self._fast_transport(ep, method, request)
+            self._fast_errs.pop((ep.service_host, ep.fast_port), None)
+            return True, out
+        except RuntimeError as e:
+            raise UnitCallError(
+                _unit_name_of(identity, ep), method, str(e)
+            ) from e
+        except ConnectionRefusedError:
+            self._fast_fail(ep, refused=True)
+            return False, None
+        except StaleConnection:
+            raise  # retryable in call(); reconnects on the next attempt
+        except TimeoutError:
+            raise  # slow unit, not a broken lane: no write-off count
+        except (ConnectionError, OSError):
+            self._fast_fail(ep, refused=False)
+            raise  # retryable in call(); next attempt may fall back
+
     async def _call_grpc(self, ep: Endpoint, method: str, request,
                          identity: tuple = ()):
         if self._fast_usable(ep):
-            if self._afast is None:
-                from seldon_tpu.runtime.fastpath import AsyncFastClient
-
-                self._afast = AsyncFastClient(timeout_s=self.timeout_s)
-            try:
-                out = await self._afast.call(
-                    ep.service_host, ep.fast_port, method, request
-                )
-                self._fast_errs.pop((ep.service_host, ep.fast_port), None)
+            handled, out = await self._fast_attempt(
+                ep, method, request, identity
+            )
+            if handled:
                 return out
-            except RuntimeError as e:
-                raise UnitCallError(
-                    _unit_name_of(identity, ep), method, str(e)
-                ) from e
-            except ConnectionRefusedError:
-                self._fast_fail(ep, refused=True)
-            except TimeoutError:
-                raise  # slow unit, not a broken lane: no write-off count
-            except (ConnectionError, OSError):
-                self._fast_fail(ep, refused=False)
-                raise  # retryable in call(); next attempt may fall back
         rpc = self._rpc(ep, method)
         cur = tracing._current_span.get()
         if cur is None:  # tracing off: the static per-unit tuple as-is
@@ -374,31 +401,23 @@ class SyncInternalClient(InternalClient):
             self._channels[addr] = ch
         return ch
 
+    async def _fast_transport(self, ep: Endpoint, method: str, request):
+        # Blocking (never-suspending) variant: per-thread persistent
+        # sockets; error policy is the shared _fast_attempt.
+        return self._fast.call(
+            ep.service_host, ep.fast_port, method, request
+        )
+
     async def _call_grpc(self, ep: Endpoint, method: str, request,
                          identity: tuple = ()):
         if self._fast_usable(ep):
-            # Blocking fast lane: one sendall+recv on a persistent
-            # per-thread socket instead of a full gRPC round trip.
-            # ConnectionError is retryable in call() (reconnects
-            # transparently); a framed unit error is a unit failure;
-            # refused/repeated failures write the lane off (_fast_fail).
-            try:
-                out = self._fast.call(
-                    ep.service_host, ep.fast_port, method, request
-                )
-                self._fast_errs.pop((ep.service_host, ep.fast_port), None)
+            # awaiting _fast_attempt completes without suspending: the
+            # overridden transport blocks instead of yielding.
+            handled, out = await self._fast_attempt(
+                ep, method, request, identity
+            )
+            if handled:
                 return out
-            except RuntimeError as e:
-                raise UnitCallError(
-                    _unit_name_of(identity, ep), method, str(e)
-                ) from e
-            except ConnectionRefusedError:
-                self._fast_fail(ep, refused=True)
-            except TimeoutError:
-                raise  # slow unit, not a broken lane: no write-off count
-            except (ConnectionError, OSError):
-                self._fast_fail(ep, refused=False)
-                raise  # retryable in call(); next attempt may fall back
         rpc = self._rpc(ep, method)
         cur = tracing._current_span.get()
         if cur is None:

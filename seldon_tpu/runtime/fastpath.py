@@ -59,16 +59,28 @@ _REQUEST_CLS = {
 }
 
 
+class StaleConnection(ConnectionError):
+    """Transport failure on a POOLED connection (peer likely restarted
+    while it sat idle): retryable, but not evidence the lane is broken —
+    callers must not count it toward the fast-lane write-off."""
+
+
 def _close_raw(raw) -> None:
-    """Release a transport's underlying fd directly — get_extra_info
-    returns a TransportSocket wrapper (no close()), and writer.close()
-    raises once the owning loop is gone."""
+    """Release a dead-loop transport's fd. DETACH the fd from the
+    underlying socket object first: the transport's __del__ will close
+    its socket later at gc time, and closing a bare fd NUMBER here would
+    let the kernel reuse it before that delayed close tears down
+    whatever live connection got the number."""
     import os
 
+    if raw is None:
+        return
     try:
-        if raw is not None:
-            os.close(raw.fileno())
-    except OSError:
+        sock = getattr(raw, "_sock", None)  # TransportSocket wrapper
+        fd = sock.detach() if sock is not None else raw.fileno()
+        if fd is not None and fd >= 0:
+            os.close(fd)
+    except (OSError, AttributeError):
         pass
 
 
@@ -176,6 +188,8 @@ class FastClient:
         unit's detail on a framed unit error."""
         addr = (host, port)
         frame = _build_frame(method, request)
+        pool = getattr(self._local, "pool", None)
+        fresh = pool is None or addr not in pool
         s = self._sock(addr)
         try:
             s.sendall(frame)
@@ -187,8 +201,13 @@ class FastClient:
                 # (the engine's fallback machinery handles it).
                 raise ConnectionError(f"fastpath frame of {n} bytes refused")
             payload = _recv_exact(s, n)
-        except (OSError, ConnectionError):
+        except TimeoutError:
             self._drop(addr)
+            raise
+        except (OSError, ConnectionError) as e:
+            self._drop(addr)
+            if not fresh:  # idle-pooled socket died: not a lane verdict
+                raise StaleConnection(str(e)) from e
             raise
         if hdr[0] != 0:
             raise RuntimeError(payload.decode("utf-8", "replace"))
@@ -248,9 +267,19 @@ class AsyncFastClient:
 
         pool = self._pool(asyncio.get_running_loop(), (host, port))
         frame = _build_frame(method, request)
-        if pool:
+        fresh = False
+        reader = writer = raw = None
+        # Skim dead pooled connections (unit restarted while they sat
+        # idle: eof is already set once the loop saw the FIN).
+        while pool:
             reader, writer, raw = pool.pop()
-        else:
+            if reader.at_eof():
+                writer.close()
+                reader = writer = raw = None
+                continue
+            break
+        if reader is None:
+            fresh = True
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, port), self.timeout_s)
             raw = writer.get_extra_info("socket")
@@ -271,12 +300,16 @@ class AsyncFastClient:
                 reader.readexactly(n), self.timeout_s)
         except asyncio.IncompleteReadError as e:
             writer.close()
+            if not fresh:  # idle-pooled conn died: not a lane verdict
+                raise StaleConnection(str(e)) from e
             raise ConnectionError(str(e)) from e
         except TimeoutError:  # mid-frame state: connection unusable,
             writer.close()    # but the CALL must not be retried
             raise
-        except (OSError, ConnectionError):
+        except (ConnectionError, OSError) as e:
             writer.close()
+            if not fresh:
+                raise StaleConnection(str(e)) from e
             raise
         pool.append((reader, writer, raw))
         if hdr[0] != 0:

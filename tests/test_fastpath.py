@@ -98,6 +98,44 @@ def test_fastpath_reconnect_after_server_restart():
         srv2.server_close()
 
 
+def test_async_fast_client_stale_pool_survives_restart():
+    """A pooled async connection dying (unit restart) surfaces as
+    StaleConnection — retryable, but never counted toward the lane
+    write-off — and the next call reconnects."""
+    import asyncio
+
+    from seldon_tpu.runtime.fastpath import AsyncFastClient, StaleConnection
+
+    srv, port = start_fast_server(EchoTags(), "127.0.0.1", 0)
+
+    async def go():
+        c = AsyncFastClient()
+        out = await c.call("127.0.0.1", port, "predict", _req([[1.0, 2.0]]))
+        arr, _, _, _ = payloads.extract_request_parts(out)
+        np.testing.assert_allclose(np.asarray(arr), [[2.0, 4.0]])
+        srv.shutdown()
+        srv.server_close()
+        srv2, _ = start_fast_server(EchoTags(), "127.0.0.1", port)
+        try:
+            try:
+                await c.call("127.0.0.1", port, "predict",
+                             _req([[1.0, 2.0]]))
+                stale = None  # at_eof skim may already have dropped it
+            except ConnectionError as e:
+                stale = e
+                # retry reconnects fresh
+                await c.call("127.0.0.1", port, "predict",
+                             _req([[1.0, 2.0]]))
+            if stale is not None:
+                assert isinstance(stale, StaleConnection), stale
+        finally:
+            await c.close()
+            srv2.shutdown()
+            srv2.server_close()
+
+    asyncio.run(go())
+
+
 def test_fastpath_threaded_clients(fast_server):
     """Per-thread sockets: concurrent callers never share a connection."""
     c = FastClient()

@@ -246,6 +246,45 @@ def test_openapi_served_at_seldon_json():
     assert "application/x-protobuf" in op["requestBody"]["content"]
 
 
+def test_wrapper_accepts_multipart_predict():
+    """The unit wrapper shares parse_message, so multipart/form-data
+    predictions (file part -> strData) work at /predict too."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web
+
+    from seldon_tpu.runtime.wrapper import build_rest_app
+
+    class EchoStr:
+        def predict_raw(self, msg):
+            from seldon_tpu.proto import prediction_pb2 as pb
+
+            out = pb.SeldonMessage()
+            out.strData = msg.strData.upper()
+            return out
+
+    async def run():
+        runner = web.AppRunner(build_rest_app(EchoStr()))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        form = aiohttp.FormData()
+        form.add_field("strData", b"shout this",
+                       filename="doc.txt", content_type="text/plain")
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(f"http://127.0.0.1:{port}/predict",
+                                 data=form) as r:
+                status, body = r.status, await r.json()
+        await runner.cleanup()
+        return status, body
+
+    status, body = asyncio.run(run())
+    assert status == 200, body
+    assert body["strData"] == "SHOUT THIS"
+
+
 def test_openapi_paths_exist_in_routers():
     """Anti-drift: every path the schema documents must be mounted by the
     actual server (spec subset-of routes, checked against the routers)."""
