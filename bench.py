@@ -73,6 +73,16 @@ SLO_ENABLED = os.environ.get(
 # chunk for A/B comparison.
 SLO_CHUNK = int(os.environ.get("BENCH_SLO_CHUNK", 0))  # 0 = adaptive
 
+# The 8B headline run ALSO records the bench-1b deployment proxy
+# (throughput + SLO search) as a trailing phase — one driver invocation
+# then captures both the honest single-chip point and the
+# TP8-deployment-shaped claim. BENCH_SECOND_PRESET= (empty) disables.
+SECOND_PRESET = os.environ.get(
+    "BENCH_SECOND_PRESET", "bench-1b" if PRESET == "llama3-8b" else ""
+)
+SECOND_SLOTS = int(os.environ.get("BENCH_SECOND_SLOTS", 0)) or 160
+SECOND_SLO = os.environ.get("BENCH_SECOND_SLO", "1") == "1"
+
 
 # ---------------------------------------------------------------------------
 # Outage-proofing (round-5). The bench rig's TPU is tunneled and the tunnel
@@ -84,10 +94,12 @@ SLO_CHUNK = int(os.environ.get("BENCH_SLO_CHUNK", 0))  # 0 = adaptive
 #     for up to BENCH_BACKEND_WAIT seconds;
 #   - the child prints a full metric JSON line after EVERY completed phase
 #     (throughput, then SLO), so a mid-run drop still records something;
-#   - the parent keeps the last metric line, retries the child once after a
-#     crash/hang (re-waiting for the backend), and prints the best line as
-#     its ONLY stdout line — the driver's `parsed` is never null unless the
-#     tunnel was down for the whole retry budget.
+#   - the parent keeps the most COMPLETE metric line (phase-scored),
+#     retries the child once after a crash/hang (re-waiting for the
+#     backend), and mirrors monotonically-improving lines to stdout so
+#     the LAST stdout line is always the best record so far — even if
+#     the driver kills the supervisor itself, `parsed` is never null
+#     unless the tunnel was down for the whole retry budget.
 # ---------------------------------------------------------------------------
 
 BACKEND_WAIT_S = float(os.environ.get("BENCH_BACKEND_WAIT", "900"))
@@ -161,8 +173,33 @@ def _wait_for_backend(max_wait_s: float) -> bool:
         delay = min(delay * 1.6, 60.0)
 
 
-def _run_child(timeout_s: float) -> tuple[int, dict | None]:
-    """Run the measurement child; stream its output; return (rc, last metric)."""
+def _phase_score(line: dict | None) -> int:
+    """Completeness ORDER for recorded lines: more finished phases beat
+    fewer, and a final (non-partial) line beats any checkpoint — a
+    retry's early partial must never clobber a richer earlier one."""
+    if not line:
+        return -1
+    d = line.get("detail", {})
+    s = 1  # headline throughput exists in every emitted line
+    if "slo_req_s" in d:
+        s += 1
+    b = d.get("bench_1b") or {}
+    if b:
+        s += 1
+    if "slo_req_s" in b:
+        s += 1
+    if not d.get("partial"):
+        s += 10
+    return s
+
+
+def _run_child(timeout_s: float, best_score: int) -> tuple[int, dict | None]:
+    """Run the measurement child; stream its output; return (rc, last metric).
+
+    Metric lines are mirrored to stdout as they arrive — but only ones
+    that IMPROVE on `best_score`, so the last stdout line is always the
+    best record so far even if the DRIVER kills this supervisor mid-run
+    (a retry's early checkpoints stay on stderr only)."""
     import subprocess
     import threading
 
@@ -173,11 +210,14 @@ def _run_child(timeout_s: float) -> tuple[int, dict | None]:
         env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
     )
     got: list[dict] = []
+    muted = threading.Event()  # set once the parent takes over stdout
+    seen = best_score
 
     def reader() -> None:
+        nonlocal seen
         assert proc.stdout is not None
         for ln in proc.stdout:
-            sys.stderr.write(ln)  # progress mirror; stdout stays parent-only
+            sys.stderr.write(ln)
             sys.stderr.flush()
             if ln.lstrip().startswith("{"):
                 try:
@@ -186,6 +226,9 @@ def _run_child(timeout_s: float) -> tuple[int, dict | None]:
                     continue
                 if isinstance(obj, dict) and "metric" in obj:
                     got.append(obj)
+                    if _phase_score(obj) > seen and not muted.is_set():
+                        seen = _phase_score(obj)
+                        print(json.dumps(obj), flush=True)
 
     th = threading.Thread(target=reader, daemon=True)
     th.start()
@@ -205,7 +248,12 @@ def _run_child(timeout_s: float) -> tuple[int, dict | None]:
             _log("child unreaped after SIGKILL (D-state?) — proceeding")
         rc = -9
     th.join(timeout=10)
-    return rc, (got[-1] if got else None)
+    muted.set()  # a straggling reader must not interleave parent stdout
+    best = None
+    for obj in got:
+        if _phase_score(obj) > _phase_score(best):
+            best = obj
+    return rc, best
 
 
 def _supervise() -> None:
@@ -223,11 +271,14 @@ def _supervise() -> None:
     for attempt in range(ATTEMPTS):
         if attempt and not _wait_for_backend(600.0):
             break
-        rc, line = _run_child(ATTEMPT_TIMEOUT_S)
+        rc, line = _run_child(ATTEMPT_TIMEOUT_S, _phase_score(best))
+        if _phase_score(line) > _phase_score(best):
+            best = line
+        if best is not None:
+            # Keep the stdout stream ending on the best-so-far at every
+            # stable point.
+            print(json.dumps(best), flush=True)
         partial = bool((line or {}).get("detail", {}).get("partial"))
-        best_partial = bool((best or {}).get("detail", {}).get("partial"))
-        if line is not None and (best is None or best_partial or not partial):
-            best = line  # never let a partial retry clobber a full record
         if rc == 0 and line is not None and not partial:
             break
         _log(f"child attempt {attempt + 1} rc={rc} "
@@ -246,7 +297,7 @@ def _supervise() -> None:
     sys.exit(1)
 
 
-def _measure_slo(params, cfg, sp) -> dict:
+def _measure_slo(params, cfg, sp, slots: int = 0) -> dict:
     """Max sustained req/s with p50 TTFT under SLO_TTFT_MS.
 
     Open-loop Poisson arrivals (throughput-latency curves from closed
@@ -264,7 +315,7 @@ def _measure_slo(params, cfg, sp) -> dict:
     # Default (SLO_CHUNK=0): the throughput config itself — adaptive
     # chunking must hold the SLO without a mode switch.
     ecfg = EngineConfig(
-        max_slots=SLOTS,
+        max_slots=slots or SLOTS,
         max_seq_len=PROMPT_LEN + NEW_TOKENS + 1,
         prompt_buckets=(PROMPT_LEN,),
         max_admit=8,
@@ -402,21 +453,15 @@ def _measure_slo(params, cfg, sp) -> dict:
     }
 
 
-def main() -> None:
-    import jax
-
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:  # explicit pin beats the sitecustomize override (see probe)
-        jax.config.update("jax_platforms", plat)
-    import numpy as np
-
-    from seldon_tpu.models import get_config, init_params
-    from seldon_tpu.models.sampling import SamplingParams
-    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
-
-    cfg = get_config(PRESET)
+def _build(preset: str):
+    """(params, cfg) for one preset under the env dtype knobs."""
     import dataclasses
 
+    import jax
+
+    from seldon_tpu.models import get_config, init_params
+
+    cfg = get_config(preset)
     if KV_DTYPE != "bf16":
         cfg = dataclasses.replace(cfg, kv_cache_dtype=KV_DTYPE)
     if ATTN:
@@ -432,22 +477,32 @@ def main() -> None:
         params = init_params_int8(cfg, jax.random.key(0))
     else:
         params = init_params(cfg, jax.random.key(0))
+    return params, cfg
+
+
+def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int):
+    """Saturated closed-loop wave -> (req_s, detail dict, sp factory)."""
+    import jax
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
 
     ecfg = EngineConfig(
-        max_slots=SLOTS,
+        max_slots=slots,
         # Tight cache window: prompt + completion + 1 slack slot. Decode
         # reads the whole window every step, so slack is pure HBM tax.
         max_seq_len=PROMPT_LEN + NEW_TOKENS + 1,
         prompt_buckets=(PROMPT_LEN,),
         max_admit=8,
-        decode_chunk=DECODE_CHUNK,
+        decode_chunk=chunk,
     )
     engine = InferenceEngine(params, cfg, ecfg)
     engine.warmup()
     engine.start()
 
     rng = np.random.default_rng(0)
-    prompts = rng.integers(3, cfg.vocab_size, size=(N_REQ, PROMPT_LEN))
+    prompts = rng.integers(3, cfg.vocab_size, size=(n_req, PROMPT_LEN))
 
     def sp(i: int) -> SamplingParams:
         # top_k=0/top_p=1: sample the full vocab — near-uniform logits on a
@@ -466,7 +521,7 @@ def main() -> None:
             pass
 
     t0 = time.perf_counter()
-    queues = [engine.submit(prompts[i].tolist(), sp(i)) for i in range(N_REQ)]
+    queues = [engine.submit(prompts[i].tolist(), sp(i)) for i in range(n_req)]
     total_toks = 0
     ttfts = []
     for q in queues:
@@ -489,12 +544,25 @@ def main() -> None:
         "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 1),
         "device": str(jax.devices()[0]),
     }
-    req_s = N_REQ / dt
+    return n_req / dt, detail, sp
+
+
+def main() -> None:
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:  # explicit pin beats the sitecustomize override (see probe)
+        jax.config.update("jax_platforms", plat)
+
+    params, cfg = _build(PRESET)
+    req_s, detail, sp = _measure_throughput(
+        params, cfg, SLOTS, N_REQ, DECODE_CHUNK
+    )
 
     def emit(partial: bool) -> None:
         d = dict(detail)
         if partial:
-            d["partial"] = True  # throughput done, SLO phase still pending
+            d["partial"] = True  # later phases still pending
         print(
             json.dumps(
                 {
@@ -515,6 +583,34 @@ def main() -> None:
     if SLO_ENABLED:
         emit(partial=True)  # phase checkpoint: survives an SLO-phase crash
         detail.update(_measure_slo(params, cfg, sp))
+
+    # Second-preset phase: the 8B headline run also records the bench-1b
+    # deployment proxy (throughput + SLO search) in detail.bench_1b —
+    # the per-chip-traffic configuration the 125 req/s/chip target
+    # actually describes. Runs AFTER the headline emits, so a driver
+    # timeout or tunnel drop can only cost this phase, never the record.
+    if SECOND_PRESET and SECOND_PRESET != PRESET:
+        emit(partial=True)
+        del params  # free the headline model's HBM before the next init
+        # The HEADLINE is already measured: a trailing-phase failure
+        # (tunnel flap during the 1b run) degrades to an error note on a
+        # COMPLETE record instead of crashing the child into a full
+        # retry that would re-pay the whole 8B measurement.
+        try:
+            p2, cfg2 = _build(SECOND_PRESET)
+            req_s2, d2, sp2 = _measure_throughput(
+                p2, cfg2, SECOND_SLOTS, 2 * SECOND_SLOTS, DECODE_CHUNK
+            )
+            d2["req_per_s"] = round(req_s2, 3)
+            d2["vs_baseline"] = round(req_s2 / BASELINE_REQ_S_PER_CHIP, 3)
+            d2["slots"] = SECOND_SLOTS
+            detail["bench_1b"] = d2
+            if SECOND_SLO:
+                emit(partial=True)  # checkpoint: 1b throughput recorded
+                d2.update(_measure_slo(p2, cfg2, sp2, slots=SECOND_SLOTS))
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"bench_1b trailing phase failed: {e!r}")
+            detail["bench_1b_error"] = str(e)
     emit(partial=False)
 
 

@@ -107,8 +107,12 @@ class InternalClient:
         self._rest_static: Dict[tuple, tuple] = {}
         # Framed-proto fast-lane state (runtime/fastpath.py), shared by
         # the async and sync variants: endpoints that refused or
-        # repeatedly failed the lane fall back to gRPC for good.
-        self._fast_dead: set = set()
+        # repeatedly failed the lane fall back to gRPC until a retry-
+        # after deadline (a TIMED write-off, not permanent: a refused
+        # connect during a unit's restart window must not demote the
+        # lane for the process lifetime; re-probing costs one failed
+        # connect per minute).
+        self._fast_dead: Dict[tuple, float] = {}  # key -> retry-after ts
         self._fast_errs: Dict[tuple, int] = {}
         self._afast = None  # lazy AsyncFastClient
 
@@ -205,24 +209,33 @@ class InternalClient:
             self._rpcs[key] = rpc
         return rpc
 
+    _FAST_RETRY_AFTER_S = 60.0
+
     def _fast_usable(self, ep: Endpoint) -> bool:
-        """Fast lane applies when the endpoint declares it, it hasn't
-        been written off, and the request is untraced (the frame carries
-        no metadata — traced requests ride full gRPC so traceparent +
-        identity headers reach the unit)."""
-        return bool(
-            ep.fast_port
-            and (ep.service_host, ep.fast_port) not in self._fast_dead
-            and tracing._current_span.get() is None
-        )
+        """Fast lane applies when the endpoint declares it, it isn't in
+        a write-off window, and the request is untraced (the frame
+        carries no metadata — traced requests ride full gRPC so
+        traceparent + identity headers reach the unit)."""
+        if not ep.fast_port or tracing._current_span.get() is not None:
+            return False
+        import time
+
+        deadline = self._fast_dead.get((ep.service_host, ep.fast_port))
+        if deadline is not None:
+            if time.monotonic() < deadline:
+                return False
+            del self._fast_dead[(ep.service_host, ep.fast_port)]
+        return True
 
     def _fast_fail(self, ep: Endpoint, refused: bool) -> None:
+        import time
+
         key = (ep.service_host, ep.fast_port)
         if refused:
-            self._fast_dead.add(key)
+            self._fast_dead[key] = time.monotonic() + self._FAST_RETRY_AFTER_S
             logger.warning(
-                "fastPort %d refused on %s — falling back to gRPC",
-                ep.fast_port, ep.service_host,
+                "fastPort %d refused on %s — gRPC for the next %.0fs",
+                ep.fast_port, ep.service_host, self._FAST_RETRY_AFTER_S,
             )
             return
         n = self._fast_errs.get(key, 0) + 1
@@ -231,11 +244,12 @@ class InternalClient:
             # e.g. the port is actually some OTHER server that accepts
             # and then drops the framed bytes: connect never refuses, so
             # repeated transport failures are the write-off signal.
-            self._fast_dead.add(key)
+            self._fast_dead[key] = time.monotonic() + self._FAST_RETRY_AFTER_S
+            self._fast_errs.pop(key, None)
             logger.warning(
                 "fastPort %d failed %d consecutive transports on %s — "
-                "falling back to gRPC",
-                ep.fast_port, n, ep.service_host,
+                "gRPC for the next %.0fs",
+                ep.fast_port, n, ep.service_host, self._FAST_RETRY_AFTER_S,
             )
 
     async def _fast_transport(self, ep: Endpoint, method: str, request):
