@@ -224,7 +224,9 @@ class InternalClient:
         if deadline is not None:
             if time.monotonic() < deadline:
                 return False
-            del self._fast_dead[(ep.service_host, ep.fast_port)]
+            # pop, not del: sync-lane worker threads share this client
+            # unlocked and may race past the same expired window.
+            self._fast_dead.pop((ep.service_host, ep.fast_port), None)
         return True
 
     def _fast_fail(self, ep: Endpoint, refused: bool) -> None:
@@ -268,12 +270,13 @@ class InternalClient:
         """One fast-lane attempt. Returns (handled, out); handled False
         means fall through to gRPC for this call. Error policy:
         - framed unit error -> UnitCallError (attributed to the unit)
-        - refused connect -> permanent gRPC fallback, handled False
+        - refused connect -> gRPC fallback for the write-off window
+          (_FAST_RETRY_AFTER_S), handled False
         - stale pooled connection died -> retryable, NOT counted toward
           the write-off (the unit just restarted; a fresh connect works)
         - timeout -> not retried, not counted (slow unit, healthy lane)
         - fresh-connection transport failure -> counted; 3 in a row
-          write the lane off."""
+          start a write-off window."""
         from seldon_tpu.runtime.fastpath import StaleConnection
 
         try:

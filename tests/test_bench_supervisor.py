@@ -1,0 +1,61 @@
+"""bench.py supervisor logic: phase-scored record selection (the
+outage-proofing that keeps the driver's perf record non-null)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_phase_score_ordering():
+    b = _load_bench()
+
+    def line(partial=False, slo=False, b1=False, b1_slo=False):
+        d = {"decode_tokens_per_s": 1.0}
+        if partial:
+            d["partial"] = True
+        if slo:
+            d["slo_req_s"] = 50.0
+        if b1:
+            d["bench_1b"] = (
+                {"req_per_s": 1.0, "slo_req_s": 90.0} if b1_slo
+                else {"req_per_s": 1.0}
+            )
+        return {"metric": "m", "value": 1.0, "detail": d}
+
+    s = b._phase_score
+    assert s(None) < s(line(partial=True))
+    # more completed phases beat fewer, among partials
+    assert s(line(partial=True)) < s(line(partial=True, slo=True))
+    assert (s(line(partial=True, slo=True))
+            < s(line(partial=True, slo=True, b1=True)))
+    assert (s(line(partial=True, slo=True, b1=True))
+            < s(line(partial=True, slo=True, b1=True, b1_slo=True)))
+    # ANY final record beats EVERY partial checkpoint
+    assert (s(line(partial=False))
+            > s(line(partial=True, slo=True, b1=True, b1_slo=True)))
+    # and among finals, richer still wins
+    assert s(line()) < s(line(slo=True, b1=True, b1_slo=True))
+
+
+def test_phase_score_retry_never_clobbers_richer_partial():
+    """The exact review scenario: attempt 1 died after 3 phases, attempt
+    2 died after 1 — the supervisor must keep attempt 1's line."""
+    b = _load_bench()
+    rich = {"metric": "m", "value": 1.0,
+            "detail": {"partial": True, "slo_req_s": 50.0,
+                       "bench_1b": {"req_per_s": 100.0}}}
+    poor = {"metric": "m", "value": 1.2, "detail": {"partial": True}}
+    best = None
+    for line in (rich, poor):
+        if b._phase_score(line) > b._phase_score(best):
+            best = line
+    assert best is rich
