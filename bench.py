@@ -103,7 +103,11 @@ SECOND_SLO = os.environ.get("BENCH_SECOND_SLO", "1") == "1"
 # ---------------------------------------------------------------------------
 
 BACKEND_WAIT_S = float(os.environ.get("BENCH_BACKEND_WAIT", "900"))
-ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "3000"))
+# 75 min: the full 8B + trailing bench-1b pipeline costs ~40-50 min
+# through the tunnel (8B int8 init alone is ~5-10 min of sequential
+# dispatches); eager stdout mirroring means a longer attempt can only
+# ADD phases to the record, never lose them.
+ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "4500"))
 ATTEMPTS = max(1, int(os.environ.get("BENCH_ATTEMPTS", "2")))
 # CPU-only runs (local smoke: JAX_PLATFORMS=cpu) must not wait 15 min for a
 # TPU that can never appear.
