@@ -101,8 +101,8 @@ def package_model(
     `docker build`. Returns the generated file paths.
 
     `language`: "python" (default, full seldon_tpu runtime), or "nodejs" /
-    "r" — foreign units speaking the JSON unit protocol (docs/wrappers.md;
-    reference wrappers/s2i/{nodejs,R})."""
+    "r" / "java" — foreign units speaking the JSON unit protocol
+    (docs/wrappers.md; reference wrappers/s2i/{nodejs,R,java})."""
     out_dir = os.path.join(model_dir, ".seldon-tpu")
     os.makedirs(out_dir, exist_ok=True)
     env = {
@@ -422,7 +422,425 @@ def generate_r_wrapper() -> Dict[str, str]:
     return {"Dockerfile": dockerfile, "microservice.R": R_MICROSERVICE}
 
 
-_FOREIGN_WRAPPERS = {"nodejs": generate_node_wrapper, "r": generate_r_wrapper}
+JAVA_MICROSERVICE = """\
+// seldon-tpu Java unit shim — JSON unit protocol (docs/wrappers.md).
+// Zero dependencies: the JDK's com.sun.net.httpserver plus a minimal
+// built-in JSON codec (the reference Java wrapper is a full Spring app;
+// wrappers/s2i/java/). The user class (selected by MODEL_NAME, compiled
+// from /microservice/<MODEL_NAME>.java) may define any of, resolved by
+// reflection on the instance:
+//   init(List params), predict(Object data, List names, Map meta),
+//   transformInput(Map msg), transformOutput(Map msg),
+//   route(Object data, List names), aggregate(List msgs),
+//   sendFeedback(Double reward, Map request, Map truth)
+
+import com.sun.net.httpserver.HttpExchange;
+import com.sun.net.httpserver.HttpServer;
+import java.io.OutputStream;
+import java.lang.reflect.Method;
+import java.net.InetSocketAddress;
+import java.net.URLDecoder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.atomic.AtomicLong;
+
+public final class Microservice {
+    static final Object ABSENT = new Object();
+    static Object user;
+    static final AtomicLong requests = new AtomicLong();
+
+    static String env(String k, String d) {
+        String v = System.getenv(k);
+        return v == null || v.isEmpty() ? d : v;
+    }
+
+    public static void main(String[] args) throws Exception {
+        int port = Integer.parseInt(env("PREDICTIVE_UNIT_SERVICE_PORT",
+                                        "9000"));
+        String model = env("MODEL_NAME", "MyModel");
+        Object params = Json.parse(env("PREDICTIVE_UNIT_PARAMETERS", "[]"));
+        user = Class.forName(model).getDeclaredConstructor().newInstance();
+        call("init", params);
+        HttpServer srv = HttpServer.create(new InetSocketAddress(port), 0);
+        srv.createContext("/", Microservice::handle);
+        srv.start();
+        System.out.println("seldon-tpu java unit " + model
+                + " listening on " + srv.getAddress().getPort());
+    }
+
+    static Object call(String name, Object... args) throws Exception {
+        for (Method m : user.getClass().getMethods()) {
+            if (m.getName().equals(name)
+                    && m.getParameterCount() == args.length) {
+                return m.invoke(user, args);
+            }
+        }
+        return ABSENT;
+    }
+
+    @SuppressWarnings("unchecked")
+    static Map<String, Object> asMap(Object o) {
+        return o instanceof Map ? (Map<String, Object>) o
+                                : new LinkedHashMap<>();
+    }
+
+    static Object[] dataOf(Map<String, Object> msg) {
+        Map<String, Object> d = asMap(msg.get("data"));
+        Object names = d.containsKey("names") ? d.get("names")
+                                              : new ArrayList<>();
+        if (d.containsKey("ndarray"))
+            return new Object[]{d.get("ndarray"), names};
+        if (d.containsKey("tensor"))
+            return new Object[]{asMap(d.get("tensor")).get("values"), names};
+        return new Object[]{null, names};
+    }
+
+    static Map<String, Object> outMessage(Object result,
+                                          Map<String, Object> inMsg) {
+        Object names = new ArrayList<>();
+        if (result instanceof Map) {
+            Map<String, Object> r = asMap(result);
+            if (r.containsKey("data") || r.containsKey("strData")
+                    || r.containsKey("binData")
+                    || r.containsKey("jsonData")) {
+                Map<String, Object> meta = asMap(inMsg.get("meta"));
+                meta.putAll(asMap(r.get("meta")));
+                // copy: the user may hand back an immutable Map.of(...)
+                Map<String, Object> full = new LinkedHashMap<>(r);
+                full.put("meta", meta);  // echo meta through
+                return full;
+            }
+            if (r.containsKey("ndarray")) {  // {names, ndarray} user shape
+                if (r.containsKey("names")) names = r.get("names");
+                result = r.get("ndarray");
+            }
+        }
+        Map<String, Object> data = new LinkedHashMap<>();
+        data.put("names", names);
+        data.put("ndarray", result);
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("meta", asMap(inMsg.get("meta")));
+        out.put("data", data);
+        return out;
+    }
+
+    static Object dispatch(String verb, Object body) throws Exception {
+        Map<String, Object> msg = asMap(body);
+        Object[] dn = dataOf(msg);
+        switch (verb) {
+            case "predict": {
+                Object r = call("predict", dn[0], dn[1],
+                                asMap(msg.get("meta")));
+                if (r == ABSENT)  // MODELs must implement predict — loud
+                    throw new IllegalStateException(
+                            "no predict(Object, List, Map) on user class");
+                return outMessage(r, msg);
+            }
+            case "transform-input": {
+                Object r = call("transformInput", msg);
+                return outMessage(r == ABSENT ? dn[0] : r, msg);
+            }
+            case "transform-output": {
+                Object r = call("transformOutput", msg);
+                return outMessage(r == ABSENT ? dn[0] : r, msg);
+            }
+            case "route": {
+                Object r = call("route", dn[0], dn[1]);
+                // Routers answer [[branch]] per the unit protocol.
+                List<Object> row = new ArrayList<>();
+                row.add(r == ABSENT ? -1 : r);
+                List<Object> branch = new ArrayList<>();
+                branch.add(row);
+                Map<String, Object> data = new LinkedHashMap<>();
+                data.put("ndarray", branch);
+                Map<String, Object> out = new LinkedHashMap<>();
+                out.put("meta", asMap(msg.get("meta")));
+                out.put("data", data);
+                return out;
+            }
+            case "aggregate": {
+                Object msgs = msg.containsKey("seldonMessages")
+                        ? msg.get("seldonMessages") : new ArrayList<>();
+                List<?> list = msgs instanceof List ? (List<?>) msgs
+                                                    : new ArrayList<>();
+                Object first = list.isEmpty() ? new LinkedHashMap<>()
+                                              : list.get(0);
+                Object r = call("aggregate", list);
+                return r == ABSENT ? first : outMessage(r, asMap(first));
+            }
+            case "send-feedback": {
+                call("sendFeedback", msg.get("reward"), msg.get("request"),
+                     msg.get("truth"));
+                Map<String, Object> out = new LinkedHashMap<>();
+                out.put("meta", asMap(asMap(msg.get("response"))
+                                      .get("meta")));
+                return out;
+            }
+            default:
+                return null;
+        }
+    }
+
+    static void handle(HttpExchange ex) {
+        try {
+            String path = ex.getRequestURI().getPath();
+            if ("GET".equals(ex.getRequestMethod())) {
+                if ("/live".equals(path) || "/ready".equals(path)) {
+                    reply(ex, 200, "{\\"status\\":\\"ok\\"}",
+                          "application/json");
+                } else if ("/metrics".equals(path)) {
+                    reply(ex, 200,
+                          "# TYPE unit_requests_total counter\\n"
+                          + "unit_requests_total " + requests.get() + "\\n",
+                          "text/plain");
+                } else {
+                    reply(ex, 404, "{\\"error\\":\\"not found\\"}",
+                          "application/json");
+                }
+                return;
+            }
+            String verb = path.replaceFirst("^/api/v[01]\\\\.[01]/", "")
+                              .replaceFirst("^/", "");
+            String raw = new String(ex.getRequestBody().readAllBytes(),
+                                    StandardCharsets.UTF_8);
+            if (raw.startsWith("json=")) {
+                raw = URLDecoder.decode(raw.substring(5),
+                                        StandardCharsets.UTF_8);
+            }
+            requests.incrementAndGet();
+            Object body;
+            try {
+                body = Json.parse(raw.isEmpty() ? "{}" : raw);
+            } catch (Exception pe) {  // protocol parity: bad json is 400
+                Map<String, Object> bad = new LinkedHashMap<>();
+                bad.put("error", "bad json: " + pe.getMessage());
+                reply(ex, 400, Json.write(bad), "application/json");
+                return;
+            }
+            Object out = dispatch(verb, body);
+            if (out == null) {
+                Map<String, Object> nf = new LinkedHashMap<>();
+                nf.put("error", "no route " + path);
+                reply(ex, 404, Json.write(nf), "application/json");
+            } else {
+                reply(ex, 200, Json.write(out), "application/json");
+            }
+        } catch (Exception e) {
+            Throwable cause = e;  // unwrap reflective user exceptions
+            while (cause instanceof java.lang.reflect
+                    .InvocationTargetException && cause.getCause() != null) {
+                cause = cause.getCause();
+            }
+            Map<String, Object> err = new LinkedHashMap<>();
+            err.put("error", cause.getMessage() == null
+                    ? cause.toString() : cause.getMessage());
+            try {
+                reply(ex, 500, Json.write(err), "application/json");
+            } catch (Exception ignored) { }
+        }
+    }
+
+    static void reply(HttpExchange ex, int code, String body, String ctype)
+            throws Exception {
+        byte[] b = body.getBytes(StandardCharsets.UTF_8);
+        ex.getResponseHeaders().set("Content-Type", ctype);
+        ex.sendResponseHeaders(code, b.length);
+        try (OutputStream os = ex.getResponseBody()) {
+            os.write(b);
+        }
+    }
+
+    /** Minimal JSON codec: objects->LinkedHashMap, arrays->ArrayList,
+     *  numbers->Double, plus String/Boolean/null. */
+    static final class Json {
+        private final String s;
+        private int i;
+        private Json(String s) { this.s = s; }
+
+        static Object parse(String s) {
+            Json p = new Json(s);
+            Object v = p.value();
+            p.ws();
+            if (p.i < p.s.length())
+                throw new IllegalArgumentException("trailing json");
+            return v;
+        }
+
+        private void ws() {
+            while (i < s.length() && Character.isWhitespace(s.charAt(i))) i++;
+        }
+
+        private Object value() {
+            ws();
+            if (i >= s.length())
+                throw new IllegalArgumentException("empty json");
+            char c = s.charAt(i);
+            if (c == '{') return object();
+            if (c == '[') return array();
+            if (c == '"') return string();
+            if (s.startsWith("true", i)) { i += 4; return Boolean.TRUE; }
+            if (s.startsWith("false", i)) { i += 5; return Boolean.FALSE; }
+            if (s.startsWith("null", i)) { i += 4; return null; }
+            return number();
+        }
+
+        private Map<String, Object> object() {
+            Map<String, Object> m = new LinkedHashMap<>();
+            i++; ws();
+            if (i < s.length() && s.charAt(i) == '}') { i++; return m; }
+            while (true) {
+                ws();
+                String k = string();
+                ws();
+                if (s.charAt(i++) != ':')
+                    throw new IllegalArgumentException("expected :");
+                m.put(k, value());
+                ws();
+                char c = s.charAt(i++);
+                if (c == '}') return m;
+                if (c != ',')
+                    throw new IllegalArgumentException("expected , or }");
+            }
+        }
+
+        private List<Object> array() {
+            List<Object> l = new ArrayList<>();
+            i++; ws();
+            if (i < s.length() && s.charAt(i) == ']') { i++; return l; }
+            while (true) {
+                l.add(value());
+                ws();
+                char c = s.charAt(i++);
+                if (c == ']') return l;
+                if (c != ',')
+                    throw new IllegalArgumentException("expected , or ]");
+            }
+        }
+
+        private String string() {
+            if (s.charAt(i) != '"')
+                throw new IllegalArgumentException("expected string");
+            StringBuilder b = new StringBuilder();
+            i++;
+            while (true) {
+                char c = s.charAt(i++);
+                if (c == '"') return b.toString();
+                if (c == '\\\\') {
+                    char e = s.charAt(i++);
+                    switch (e) {
+                        case 'n': b.append('\\n'); break;
+                        case 't': b.append('\\t'); break;
+                        case 'r': b.append('\\r'); break;
+                        case 'b': b.append('\\b'); break;
+                        case 'f': b.append('\\f'); break;
+                        case 'u':
+                            b.append((char) Integer.parseInt(
+                                    s.substring(i, i + 4), 16));
+                            i += 4;
+                            break;
+                        default: b.append(e);
+                    }
+                } else {
+                    b.append(c);
+                }
+            }
+        }
+
+        private Double number() {
+            int start = i;
+            while (i < s.length()
+                    && "+-0123456789.eE".indexOf(s.charAt(i)) >= 0) i++;
+            return Double.parseDouble(s.substring(start, i));
+        }
+
+        static String write(Object v) {
+            StringBuilder b = new StringBuilder();
+            writeTo(v, b);
+            return b.toString();
+        }
+
+        private static void writeTo(Object v, StringBuilder b) {
+            if (v == null) { b.append("null"); return; }
+            if (v instanceof String) {
+                b.append('"');
+                for (char c : ((String) v).toCharArray()) {
+                    switch (c) {
+                        case '"': b.append("\\\\\\""); break;
+                        case '\\\\': b.append("\\\\\\\\"); break;
+                        case '\\n': b.append("\\\\n"); break;
+                        case '\\t': b.append("\\\\t"); break;
+                        case '\\r': b.append("\\\\r"); break;
+                        default:
+                            if (c < 0x20) {
+                                b.append(String.format("\\\\u%04x", (int) c));
+                            } else {
+                                b.append(c);
+                            }
+                    }
+                }
+                b.append('"');
+            } else if (v instanceof Map) {
+                b.append('{');
+                boolean first = true;
+                for (Map.Entry<?, ?> e : ((Map<?, ?>) v).entrySet()) {
+                    if (!first) b.append(',');
+                    first = false;
+                    writeTo(String.valueOf(e.getKey()), b);
+                    b.append(':');
+                    writeTo(e.getValue(), b);
+                }
+                b.append('}');
+            } else if (v instanceof List) {
+                b.append('[');
+                boolean first = true;
+                for (Object e : (List<?>) v) {
+                    if (!first) b.append(',');
+                    first = false;
+                    writeTo(e, b);
+                }
+                b.append(']');
+            } else if (v instanceof Double && (((Double) v).isNaN()
+                    || ((Double) v).isInfinite())) {
+                b.append("null");  // JSON has no NaN/Infinity tokens
+            } else if (v instanceof Double
+                    && ((Double) v) == Math.floor((Double) v)
+                    && Math.abs((Double) v) < 1e15) {
+                b.append((long) (double) (Double) v);
+            } else {
+                b.append(v);  // numbers, booleans
+            }
+        }
+    }
+}
+"""
+
+
+def generate_java_wrapper() -> Dict[str, str]:
+    """Java unit image files: {relpath: content}. The user's model dir
+    holds <MODEL_NAME>.java (public class per the shim's reflection
+    contract); both are compiled by javac in the image build — no Maven,
+    no Spring (reference wrappers/s2i/java/ ships a Spring template)."""
+    dockerfile = "\n".join([
+        "FROM eclipse-temurin:21-jdk",
+        "WORKDIR /microservice",
+        "COPY . /microservice",
+        "COPY .seldon-tpu/Microservice.java /microservice/.seldon-tpu/",
+        "RUN javac -d /microservice/.seldon-tpu/classes "
+        "/microservice/.seldon-tpu/Microservice.java "
+        "$(find /microservice -maxdepth 1 -name '*.java')",
+        "EXPOSE 9000",
+        "ENV PREDICTIVE_UNIT_SERVICE_PORT=9000",
+        'CMD ["java", "-cp", "/microservice/.seldon-tpu/classes", '
+        '"Microservice"]',
+    ]) + "\n"
+    return {"Dockerfile": dockerfile, "Microservice.java": JAVA_MICROSERVICE}
+
+
+_FOREIGN_WRAPPERS = {"nodejs": generate_node_wrapper, "r": generate_r_wrapper,
+                     "java": generate_java_wrapper}
 
 
 def _bake_env(dockerfile: str, env: Dict[str, str]) -> str:
@@ -542,7 +960,7 @@ def main(argv=None) -> None:  # pragma: no cover - CLI entry
     parser.add_argument("--build", action="store_true")
     parser.add_argument("--image-tag", default=None)
     parser.add_argument("--language", default="python",
-                        choices=["python", "nodejs", "r"])
+                        choices=["python", "nodejs", "r", "java"])
     args = parser.parse_args(argv)
     out = package_model(
         args.model_dir, args.model_name, args.service_type, args.api_type,

@@ -50,6 +50,7 @@ def test_dockerfile_tpu_variant():
 @pytest.mark.parametrize("language,serve_key,serve_name", [
     ("nodejs", "microservice_js", "microservice.js"),
     ("r", "microservice_r", "microservice.R"),
+    ("java", "microservice_java", "Microservice.java"),
 ])
 def test_package_model_foreign_language(tmp_path, language, serve_key,
                                         serve_name):
@@ -118,6 +119,59 @@ def test_node_shim_boots_if_node_available(tmp_path):
                     json={"data": {"ndarray": [[1, 2]]}}, timeout=10)
         assert r.status_code == 200
         assert r.json()["data"]["ndarray"] == [[2, 4]]
+    finally:
+        proc.kill()
+
+
+def test_java_shim_compiles_and_boots_if_jdk_available(tmp_path):
+    """Full compile + boot test of the java shim when a JDK exists
+    (skipped in images without one — render is still pinned by
+    test_package_model_foreign_language)."""
+    import shutil as _sh
+
+    javac, java = _sh.which("javac"), _sh.which("java")
+    if javac is None or java is None:
+        pytest.skip("JDK not installed in this image")
+    (tmp_path / "MyModel.java").write_text(
+        "import java.util.*;\n"
+        "public class MyModel {\n"
+        "    public Object predict(Object data, List names, Map meta) {\n"
+        "        List<Object> out = new ArrayList<>();\n"
+        "        for (Object row : (List<?>) data) {\n"
+        "            List<Object> r = new ArrayList<>();\n"
+        "            for (Object v : (List<?>) row)\n"
+        "                r.add(((Number) v).doubleValue() * 2);\n"
+        "            out.add(r);\n"
+        "        }\n"
+        "        return out;\n"
+        "    }\n"
+        "}\n"
+    )
+    out = package_model(str(tmp_path), "MyModel", language="java")
+    classes = tmp_path / "classes"
+    subprocess.run(
+        [javac, "-d", str(classes), out["microservice_java"],
+         str(tmp_path / "MyModel.java")],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ)
+    env.update({"MODEL_NAME": "MyModel",
+                "PREDICTIVE_UNIT_SERVICE_PORT": "0"})
+    proc = subprocess.Popen([java, "-cp", str(classes), "Microservice"],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "listening" in line, line
+        import re
+
+        port = int(re.search(r"listening on (\d+)", line).group(1))
+        r = rq.post(f"http://127.0.0.1:{port}/predict",
+                    json={"data": {"ndarray": [[1, 2]]}}, timeout=10)
+        assert r.status_code == 200
+        assert r.json()["data"]["ndarray"] == [[2, 4]]
+        r = rq.post(f"http://127.0.0.1:{port}/api/v0.1/route",
+                    json={"data": {"ndarray": [[1]]}}, timeout=10)
+        assert r.json()["data"]["ndarray"] == [[-1]]
     finally:
         proc.kill()
 
