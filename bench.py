@@ -35,11 +35,19 @@ import time
 PRESET = os.environ.get("BENCH_PRESET", "llama3-8b")
 # Slot-count knees measured per preset: bench-1b 160 (96 -> 77 req/s,
 # 160 -> 96, 192 -> 95, 256 -> 68: past ~160 the KV read outgrows the
-# weight-read amortization); llama3-8b 160 (decode step ms at 96/160/
-# 256/320 = 18.7/24.5/44.8/55.5 -> tok/s 5138/6530/5713/5766 — the 256+
-# cliff is superlinear step cost, not KV growth).
-SLOTS = int(os.environ.get("BENCH_SLOTS", 0)) or 160
+# weight-read amortization); llama3-8b 192 (round-5 end-to-end ladder
+# via tools/tune_8b, slots:admit:chunk -> req/s: 160:8:64 -> 32.0,
+# 192:8:64 -> 32.1, 224:8:64 -> 25.7 (cliff), 192:16:64 -> 32.4 (best),
+# 192:8:32 -> 32.1 — flat at the knee; docs/benchmarking.md derives why
+# the residual gap to north star is the prefill-compute + weight-read
+# interleave, not slot count).
+SLOTS = int(os.environ.get("BENCH_SLOTS", 0)) or (
+    192 if PRESET == "llama3-8b" else 160
+)
 N_REQ = int(os.environ.get("BENCH_NREQ", 0)) or 2 * SLOTS
+MAX_ADMIT = int(os.environ.get("BENCH_ADMIT", 0)) or (
+    16 if PRESET == "llama3-8b" else 8
+)
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", 128))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW", 128))
 DECODE_CHUNK = int(os.environ.get("BENCH_CHUNK", 64))  # 32 -> 0.78x, 64 -> 0.82x
@@ -484,7 +492,8 @@ def _build(preset: str):
     return params, cfg
 
 
-def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int):
+def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
+                        admit: int = 8):
     """Saturated closed-loop wave -> (req_s, detail dict, sp factory)."""
     import jax
     import numpy as np
@@ -498,7 +507,7 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int):
         # reads the whole window every step, so slack is pure HBM tax.
         max_seq_len=PROMPT_LEN + NEW_TOKENS + 1,
         prompt_buckets=(PROMPT_LEN,),
-        max_admit=8,
+        max_admit=admit,
         decode_chunk=chunk,
     )
     engine = InferenceEngine(params, cfg, ecfg)
@@ -560,7 +569,7 @@ def main() -> None:
 
     params, cfg = _build(PRESET)
     req_s, detail, sp = _measure_throughput(
-        params, cfg, SLOTS, N_REQ, DECODE_CHUNK
+        params, cfg, SLOTS, N_REQ, DECODE_CHUNK, admit=MAX_ADMIT
     )
 
     def emit(partial: bool) -> None:
