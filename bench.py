@@ -62,6 +62,12 @@ ATTN = os.environ.get("BENCH_ATTN", "")
 # Weight-only int8 (per-channel scales): faster than bf16 weights and
 # half the footprint; quality pinned by tests. BENCH_WEIGHTS=bf16 reverts.
 WEIGHTS = os.environ.get("BENCH_WEIGHTS", "int8")
+# W8A8 matmul activations (round 5): decode is COMPUTE-bound past the
+# slot knee and the v5e MXU runs s8 x s8 at double rate; dynamic
+# per-token A8 meets the same tiny-geometry quality bars that admitted
+# int8 weights/KV (tests/test_models.py::test_w8a8_*). BENCH_ACT=bf16
+# reverts to bf16-math matmuls.
+ACT = os.environ.get("BENCH_ACT", "int8")
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
@@ -481,6 +487,8 @@ def _build(preset: str):
     # Unconditional: BENCH_WEIGHTS must also be able to REVERT a preset
     # that ships int8.
     cfg = dataclasses.replace(cfg, weight_dtype=WEIGHTS)
+    if WEIGHTS == "int8":
+        cfg = dataclasses.replace(cfg, act_dtype=ACT)
     if cfg.weight_dtype == "int8":
         # Memory-aware init: generates straight into int8 buffers, so
         # llama3-8b geometry (16 GB bf16) inits on one 16 GB chip.

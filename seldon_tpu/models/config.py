@@ -44,6 +44,14 @@ class ModelConfig:
     # footprint (llama3-8b on one 16GB v5e chip needs this). Applied by
     # loaders via quantize_params; compute stays bf16.
     weight_dtype: str = "bf16"
+    # "bf16" or "int8": MATMUL ACTIVATION dtype (W8A8). With int8 weights,
+    # dynamic per-token activation quantization feeds s8 x s8 -> s32
+    # matmuls — the v5e MXU runs those at double rate, which matters
+    # because decode is COMPUTE-bound past the slot knee (round-5
+    # profile, docs/benchmarking.md). Applies to the dense projections
+    # (qkv/o, SwiGLU); lm_head/embeddings stay bf16 for logit quality.
+    # No-op unless weight_dtype is int8.
+    act_dtype: str = "bf16"
     # RoPE frequency scaling (long-context checkpoints). Flat scalar
     # fields rather than a dict so the frozen config stays hashable.
     # rope_scaling_type: None (no scaling), "linear" (inv_freq / factor),
@@ -77,6 +85,9 @@ class ModelConfig:
         )
         assert self.weight_dtype in ("bf16", "int8"), (
             f"unknown weight_dtype {self.weight_dtype!r}"
+        )
+        assert self.act_dtype in ("bf16", "int8"), (
+            f"unknown act_dtype {self.act_dtype!r}"
         )
         assert self.rope_scaling_type in (None, "linear", "llama3"), (
             f"unknown rope_scaling_type {self.rope_scaling_type!r}"

@@ -34,6 +34,43 @@ def _w(container: Dict[str, Any], name: str, dtype) -> jnp.ndarray:
     return dequant(container[name], container.get(name + "_scale"), dtype)
 
 
+def _quantize_act(x: jnp.ndarray):
+    """Dynamic per-token symmetric int8 for W8A8 matmul inputs:
+    x [..., D] -> (int8 [..., D], f32 scale [..., 1])."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s), -127, 127
+    ).astype(jnp.int8)
+    return q, s
+
+
+def _qdot(x: jnp.ndarray, container: Dict[str, Any], name: str,
+          cfg: ModelConfig) -> jnp.ndarray:
+    """x [..., D] @ W [D, F] with optional W8A8.
+
+    When cfg.act_dtype == "int8" and the weight is int8-quantized:
+    dynamic per-token A8 feeds an s8 x s8 -> s32 dot — the v5e MXU runs
+    int8 at double rate, and the round-5 profile shows decode is
+    COMPUTE-bound past the slot knee, so this halves the binding
+    resource (probe: tools/probe_w8a8.py, 2.2x on the MLP stack).
+    Scales apply to the f32 output; exact algebra since weight scales
+    are per-output-channel ([1, F]). Otherwise falls back to the
+    dequant-in-fusion bf16-math path (identical contraction to the
+    einsums it replaces)."""
+    w = container[name]
+    wscale = container.get(name + "_scale")
+    if (cfg.act_dtype != "int8" or wscale is None
+            or w.dtype != jnp.int8):
+        return jnp.einsum("...d,df->...f", x, dequant(w, wscale, x.dtype))
+    xq, xs = _quantize_act(x)
+    y = jax.lax.dot_general(
+        xq, w, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (y.astype(jnp.float32) * xs
+            * wscale.astype(jnp.float32)).astype(x.dtype)
+
+
 def _embed_rows(params: Params, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
     """Embedding gather with transparent dequant (scale is per-column,
     so it broadcasts over gathered rows)."""
@@ -246,13 +283,6 @@ def gqa_attention_decode(
     return out.reshape(B, S, H * Dh)
 
 
-def swiglu(x, w_gate, w_up, w_down):
-    return jnp.einsum(
-        "bsf,fd->bsd", jax.nn.silu(jnp.einsum("bsd,df->bsf", x, w_gate))
-        * jnp.einsum("bsd,df->bsf", x, w_up), w_down
-    )
-
-
 def moe_block(x: jnp.ndarray, bp: Dict[str, jnp.ndarray], cfg: ModelConfig):
     """Top-k MoE. Dense-mixing formulation: every expert runs on every token
     and results are combined with the (sparsified) router weights. This is
@@ -354,7 +384,7 @@ def _block(
     else:
         attn = gqa_attention(q, k, v, mask)
 
-    x = x + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
+    x = x + _qdot(attn, bp, "wo", cfg)
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
     x, aux = _mlp_res(x, bp, cfg, act_spec)
@@ -379,12 +409,9 @@ def _run_blocks(params, x, cfg, positions, inv_freq, mask,
 def _qkv(h, bp, cfg, positions, inv_freq):
     B, S, _ = h.shape
     Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
-    q = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wq", h.dtype)).reshape(
-        B, S, cfg.n_heads, Dh)
-    k = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wk", h.dtype)).reshape(
-        B, S, Hkv, Dh)
-    v = jnp.einsum("bsd,dh->bsh", h, _w(bp, "wv", h.dtype)).reshape(
-        B, S, Hkv, Dh)
+    q = _qdot(h, bp, "wq", cfg).reshape(B, S, cfg.n_heads, Dh)
+    k = _qdot(h, bp, "wk", cfg).reshape(B, S, Hkv, Dh)
+    v = _qdot(h, bp, "wv", cfg).reshape(B, S, Hkv, Dh)
     return apply_rope(q, positions, inv_freq), apply_rope(k, positions, inv_freq), v
 
 
@@ -396,8 +423,9 @@ def _mlp_res(x, bp, cfg, act_spec):
         mlp_out, aux = moe_block(h, bp, cfg)
         x = x + mlp_out
     else:
-        x = x + swiglu(h, _w(bp, "w_gate", h.dtype),
-                       _w(bp, "w_up", h.dtype), _w(bp, "w_down", h.dtype))
+        hidden = jax.nn.silu(_qdot(h, bp, "w_gate", cfg)) \
+            * _qdot(h, bp, "w_up", cfg)
+        x = x + _qdot(hidden, bp, "w_down", cfg)
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
     return x, aux
@@ -445,7 +473,7 @@ def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
                     .transpose(0, 2, 1, 3).reshape(B, S, -1))
         else:
             attn = gqa_attention(q, k, v, mask)
-        x = carry + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
+        x = carry + _qdot(attn, bp, "wo", cfg)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
         x, aux = _mlp_res(x, bp, cfg, act_spec)
@@ -485,7 +513,7 @@ def _run_blocks_decode(params, x, cfg, positions, inv_freq, pos, cache,
         h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
         attn = attend(q, k, v, cl)
-        x = carry + jnp.einsum("bsh,hd->bsd", attn, _w(bp, "wo", attn.dtype))
+        x = carry + _qdot(attn, bp, "wo", cfg)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
         x, aux = _mlp_res(x, bp, cfg, act_spec)

@@ -49,6 +49,7 @@ class JAXServer(SeldonComponent):
         init_seed: int = 0,
         warmup: int = 0,
         weight_dtype: str = "",
+        act_dtype: str = "",
         mesh_sp: int = 0,
     ):
         self.model_uri = model_uri
@@ -70,6 +71,10 @@ class JAXServer(SeldonComponent):
         self.weight_dtype = (
             weight_dtype or _os.environ.get("WEIGHT_DTYPE", "")
         )
+        # W8A8 matmuls (models/transformer._qdot); only meaningful when
+        # the weights are int8 — selected like weight_dtype (unit
+        # parameter / ACT_DTYPE env).
+        self.act_dtype = act_dtype or _os.environ.get("ACT_DTYPE", "")
         self._loaded = False
         self._load_lock = threading.Lock()
         self.engine: Optional[InferenceEngine] = None
@@ -150,6 +155,10 @@ class JAXServer(SeldonComponent):
                 import dataclasses as _dc
 
                 cfg = _dc.replace(cfg, weight_dtype=self.weight_dtype)
+            if self.act_dtype and cfg.weight_dtype == "int8":
+                import dataclasses as _dc
+
+                cfg = _dc.replace(cfg, act_dtype=self.act_dtype)
             if cfg.weight_dtype == "int8":
                 from seldon_tpu.models.quantize import quantize_params
 

@@ -290,6 +290,111 @@ def test_int8_weight_quantization_close_to_bf16():
         assert agree > 0.9, (preset, agree)
 
 
+def test_w8a8_matches_bf16_math():
+    """act_dtype='int8' (W8A8: dynamic per-token A8 + s8 x s8 matmuls):
+    logits stay close to the int8-weight/bf16-math path and greedy
+    argmax mostly agrees. Also: act_dtype is a NO-OP on unquantized
+    weights (the _qdot fallback is the same contraction)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from seldon_tpu.models import forward, get_config, init_params
+    from seldon_tpu.models.quantize import quantize_params
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    q = quantize_params(params)
+    tokens = jax.random.randint(jax.random.key(1), (2, 12), 0,
+                                cfg.vocab_size)
+    cfg_a8 = dataclasses.replace(cfg, weight_dtype="int8",
+                                 act_dtype="int8")
+    ref = np.asarray(forward(q, tokens, cfg), np.float32)
+    out = np.asarray(forward(q, tokens, cfg_a8), np.float32)
+    denom = np.abs(ref).max() + 1e-6
+    rel = np.abs(ref - out).max() / denom
+    assert rel < 0.08, rel
+    agree = (ref.argmax(-1) == out.argmax(-1)).mean()
+    assert agree > 0.9, agree
+    # bf16-weight params: act_dtype must be a no-op (falls back).
+    plain = np.asarray(
+        forward(params, tokens, dataclasses.replace(cfg, act_dtype="int8")),
+        np.float32)
+    base = np.asarray(forward(params, tokens, cfg), np.float32)
+    np.testing.assert_allclose(plain, base, rtol=0, atol=0)
+
+
+def test_w8a8_matches_bf16_math_decode_stepwise():
+    """Teacher-forced decode with W8A8 matmuls tracks the
+    int8-weight/bf16-math path step by step (same methodology and bars
+    as the int8-KV acceptance test above: per-step relative logit
+    error, not greedy-token luck)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_tpu.models import get_config, init_params, transformer
+    from seldon_tpu.models.quantize import quantize_params
+
+    cfg = dataclasses.replace(get_config("tiny"), weight_dtype="int8")
+    params = quantize_params(init_params(get_config("tiny"),
+                                         jax.random.key(0)))
+    prompt = jnp.array([[5, 6, 7, 8]], jnp.int32)
+    forced = [5, 9, 3, 200, 77, 13, 42, 250]
+
+    def run(c):
+        cache = transformer.init_cache(c, 1, 32)
+        logits, cache = transformer.prefill(
+            params, prompt, jnp.array([4]), cache, c
+        )
+        lgs = [logits]
+        pos = jnp.array([4], jnp.int32)
+        for t in forced:
+            lg, cache = transformer.decode_step(
+                params, jnp.array([t], jnp.int32), pos, cache, c
+            )
+            lgs.append(lg)
+            pos = pos + 1
+        return lgs
+
+    ref = run(cfg)
+    a8 = run(dataclasses.replace(cfg, act_dtype="int8"))
+    for i, (a, b) in enumerate(zip(ref, a8)):
+        rel = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
+        assert rel < 0.05, (i, rel)
+
+
+def test_w8a8_full_serving_path():
+    """Engine decode with W8A8 matmuls + int8 KV end-to-end."""
+    import dataclasses
+
+    import jax
+
+    from seldon_tpu.models import get_config, init_params
+    from seldon_tpu.models.quantize import quantize_params
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    cfg = dataclasses.replace(get_config("tiny"), weight_dtype="int8",
+                              kv_cache_dtype="int8", act_dtype="int8")
+    params = quantize_params(init_params(cfg, jax.random.key(0)))
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(max_slots=4, max_seq_len=64, prompt_buckets=(16,),
+                     max_admit=2, decode_chunk=4),
+    )
+    eng.start()
+    try:
+        out = eng.generate_blocking(
+            [5, 6, 7], SamplingParams(max_new_tokens=10, seed=0)
+        )
+        assert len(out["token_ids"]) >= 1
+    finally:
+        eng.stop()
+
+
 def test_int8_weights_full_serving_path():
     """Engine decode on quantized weights (+ optionally quantized cache)."""
     import dataclasses

@@ -1,0 +1,106 @@
+"""Probe: would W8A8 (int8 activations x int8 weights -> int32 on the
+MXU) lift the compute-bound decode step? The round-5 profile shows
+decode matmuls at ~75% of bf16 peak past the slot knee, so a native-rate
+s8xs8 path would halve the compute floor IF the backend runs it at 2x.
+This times the bench-1b MLP stack (same shapes as probe_qmm) three
+ways: bf16 math (current path), s8xs8 -> s32 with output scaling, and
+a dynamic per-token A8 quantize + s8xs8 (the real deployment shape of
+the idea, quantize cost included).
+
+Run alone on the real chip: python -m tools.probe_w8a8
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+B, D, F, L = 160, 2048, 5632, 16
+CHUNK = 32
+
+
+def quant_w(w):
+    s = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    return jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8), s
+
+
+def run(name, layer_fn, weights):
+    @jax.jit
+    def f(x, weights):
+        def step(x, _):
+            def body(h, ws):
+                return layer_fn(h, ws), ()
+            h, _ = jax.lax.scan(body, x, weights)
+            return h * 1e-3 + x[0, 0] * 0, ()
+        x, _ = jax.lax.scan(step, x, None, length=CHUNK)
+        return x
+
+    from tools.timing import slope_time
+
+    x = jnp.ones((B, 1, D), jnp.bfloat16)
+    dt, _ = slope_time(lambda s: f(s, weights), x, k1=2, k2=8)
+    print(f"{name:16s} {dt/CHUNK*1000:7.3f} ms/step", flush=True)
+
+
+def main():
+    ks = jax.random.split(jax.random.key(0), 3 * L)
+    wg = jax.random.normal(ks[0], (L, D, F), jnp.float32) * 0.02
+    wu = jax.random.normal(ks[1], (L, D, F), jnp.float32) * 0.02
+    wd = jax.random.normal(ks[2], (L, F, D), jnp.float32) * 0.02
+    (wgq, sg), (wuq, su), (wdq, sd) = quant_w(wg), quant_w(wu), quant_w(wd)
+
+    def layer_bf16(h, ws):
+        g, u, d = ws
+        return h + jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.silu(jnp.einsum("bsd,df->bsf", h, g))
+            * jnp.einsum("bsd,df->bsf", h, u), d)
+
+    bf = (wg.astype(jnp.bfloat16), wu.astype(jnp.bfloat16),
+          wd.astype(jnp.bfloat16))
+
+    def mm_s8(x8, w8):
+        # s8 x s8 -> s32: native-rate MXU path if the backend has one.
+        return jax.lax.dot_general(
+            x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    def quant_a(h):
+        # dynamic per-token symmetric A8
+        s = jnp.max(jnp.abs(h), axis=-1, keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-8)
+        return jnp.clip(jnp.round(h / s), -127, 127).astype(jnp.int8), s
+
+    def layer_w8a8_prequant(h, ws):
+        # activations pretend-quantized for free (isolates MXU rate):
+        g, sgv, u, suv, d, sdv = ws
+        x8 = h.astype(jnp.int8)  # cast only; cost-free stand-in
+        gate = mm_s8(x8, g).astype(jnp.bfloat16) * sgv.astype(jnp.bfloat16)
+        up = mm_s8(x8, u).astype(jnp.bfloat16) * suv.astype(jnp.bfloat16)
+        hid8 = (jax.nn.silu(gate) * up).astype(jnp.int8)
+        down = mm_s8(hid8, d).astype(jnp.bfloat16) * sdv.astype(jnp.bfloat16)
+        return h + down
+
+    def layer_w8a8_dynamic(h, ws):
+        # the real thing: quantize activations per token, scale outputs
+        g, sgv, u, suv, d, sdv = ws
+        x8, sa = quant_a(h)
+        sc = sa.astype(jnp.bfloat16)
+        gate = (mm_s8(x8, g).astype(jnp.bfloat16)
+                * sc * sgv.astype(jnp.bfloat16))
+        up = (mm_s8(x8, u).astype(jnp.bfloat16)
+              * sc * suv.astype(jnp.bfloat16))
+        hid = jax.nn.silu(gate) * up
+        h8, sh = quant_a(hid)
+        down = (mm_s8(h8, d).astype(jnp.bfloat16)
+                * sh.astype(jnp.bfloat16) * sdv.astype(jnp.bfloat16))
+        return h + down
+
+    q = (wgq, sg, wuq, su, wdq, sd)
+    run("bf16 (current)", layer_bf16, bf)
+    run("s8xs8 cast-only", layer_w8a8_prequant, q)
+    run("s8xs8 dynamic-A8", layer_w8a8_dynamic, q)
+
+
+if __name__ == "__main__":
+    main()
