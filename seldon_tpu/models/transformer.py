@@ -45,8 +45,15 @@ def _quantize_act(x: jnp.ndarray):
     return q, s
 
 
+def _w8a8_applies(container: Dict[str, Any], name: str,
+                  cfg: ModelConfig) -> bool:
+    return (cfg.act_dtype == "int8"
+            and container[name].dtype == jnp.int8
+            and container.get(name + "_scale") is not None)
+
+
 def _qdot(x: jnp.ndarray, container: Dict[str, Any], name: str,
-          cfg: ModelConfig) -> jnp.ndarray:
+          cfg: ModelConfig, act_q=None) -> jnp.ndarray:
     """x [..., D] @ W [D, F] with optional W8A8.
 
     When cfg.act_dtype == "int8" and the weight is int8-quantized:
@@ -57,13 +64,14 @@ def _qdot(x: jnp.ndarray, container: Dict[str, Any], name: str,
     Scales apply to the f32 output; exact algebra since weight scales
     are per-output-channel ([1, F]). Otherwise falls back to the
     dequant-in-fusion bf16-math path (identical contraction to the
-    einsums it replaces)."""
+    einsums it replaces). `act_q` shares one _quantize_act(x) across
+    the projections that consume the same input (XLA CSE would dedupe
+    anyway under jit; sharing keeps eager/debug runs cheap too)."""
     w = container[name]
     wscale = container.get(name + "_scale")
-    if (cfg.act_dtype != "int8" or wscale is None
-            or w.dtype != jnp.int8):
+    if not _w8a8_applies(container, name, cfg):
         return jnp.einsum("...d,df->...f", x, dequant(w, wscale, x.dtype))
-    xq, xs = _quantize_act(x)
+    xq, xs = act_q if act_q is not None else _quantize_act(x)
     y = jax.lax.dot_general(
         xq, w, (((xq.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
@@ -409,9 +417,10 @@ def _run_blocks(params, x, cfg, positions, inv_freq, mask,
 def _qkv(h, bp, cfg, positions, inv_freq):
     B, S, _ = h.shape
     Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
-    q = _qdot(h, bp, "wq", cfg).reshape(B, S, cfg.n_heads, Dh)
-    k = _qdot(h, bp, "wk", cfg).reshape(B, S, Hkv, Dh)
-    v = _qdot(h, bp, "wv", cfg).reshape(B, S, Hkv, Dh)
+    hq = _quantize_act(h) if _w8a8_applies(bp, "wq", cfg) else None
+    q = _qdot(h, bp, "wq", cfg, act_q=hq).reshape(B, S, cfg.n_heads, Dh)
+    k = _qdot(h, bp, "wk", cfg, act_q=hq).reshape(B, S, Hkv, Dh)
+    v = _qdot(h, bp, "wv", cfg, act_q=hq).reshape(B, S, Hkv, Dh)
     return apply_rope(q, positions, inv_freq), apply_rope(k, positions, inv_freq), v
 
 
@@ -423,8 +432,9 @@ def _mlp_res(x, bp, cfg, act_spec):
         mlp_out, aux = moe_block(h, bp, cfg)
         x = x + mlp_out
     else:
-        hidden = jax.nn.silu(_qdot(h, bp, "w_gate", cfg)) \
-            * _qdot(h, bp, "w_up", cfg)
+        hq = _quantize_act(h) if _w8a8_applies(bp, "w_gate", cfg) else None
+        hidden = jax.nn.silu(_qdot(h, bp, "w_gate", cfg, act_q=hq)) \
+            * _qdot(h, bp, "w_up", cfg, act_q=hq)
         x = x + _qdot(hidden, bp, "w_down", cfg)
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)

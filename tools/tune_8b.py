@@ -69,7 +69,10 @@ def main():
     for arg in sys.argv[1:] or ["96:8:64", "160:8:64", "160:16:64"]:
         s, a, c = (int(x) for x in arg.split(":"))
         combos.append((s, a, c))
-    cfg = get_config("llama3-8b", kv_cache_dtype="int8", weight_dtype="int8")
+    import os
+
+    cfg = get_config("llama3-8b", kv_cache_dtype="int8", weight_dtype="int8",
+                     act_dtype=os.environ.get("TUNE_ACT", "int8"))
     params = init_params_int8(cfg, jax.random.key(0))
     for s, a, c in combos:
         run(params, cfg, s, a, c)
