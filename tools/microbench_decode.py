@@ -57,8 +57,13 @@ def chunk_impl(params, state, *, cfg, n_steps):
 
 
 def bench(weights: str, kv: str, attn: str = "xla") -> float:
+    # MB_ACT mirrors BENCH_ACT/TUNE_ACT: int8 (the adopted W8A8 serving
+    # default) unless reverted — so a plain rerun reproduces the
+    # recorded numbers. Only applies when weights are int8.
     cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
-                     attn_impl=attn)
+                     attn_impl=attn,
+                     act_dtype=os.environ.get(
+                         "MB_ACT", "int8" if weights == "int8" else "bf16"))
     if weights == "int8":
         # Memory-aware: 8B geometry can't materialize bf16 then quantize.
         from seldon_tpu.models.quantize import init_params_int8
