@@ -46,6 +46,28 @@ def test_phase_score_ordering():
     assert s(line()) < s(line(slo=True, b1=True, b1_slo=True))
 
 
+def test_build_act_dtype_gating(monkeypatch):
+    """BENCH_ACT (W8A8) only engages when weights are int8; BENCH_ACT
+    and BENCH_WEIGHTS env reverts both stay honored."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    monkeypatch.delenv("BENCH_WEIGHTS", raising=False)
+    monkeypatch.delenv("BENCH_ACT", raising=False)
+    b = _load_bench()
+    assert b.ACT == "int8" and b.WEIGHTS == "int8"  # round-5 defaults
+    _, cfg = b._build("tiny")
+    assert cfg.weight_dtype == "int8" and cfg.act_dtype == "int8"
+    monkeypatch.setenv("BENCH_WEIGHTS", "bf16")
+    _, cfg2 = _load_bench()._build("tiny")
+    # bf16 weights -> W8A8 must stay off regardless of ACT default.
+    assert cfg2.weight_dtype == "bf16" and cfg2.act_dtype == "bf16"
+    monkeypatch.delenv("BENCH_WEIGHTS")
+    monkeypatch.setenv("BENCH_ACT", "bf16")
+    _, cfg3 = _load_bench()._build("tiny")
+    assert cfg3.weight_dtype == "int8" and cfg3.act_dtype == "bf16"
+
+
 def test_phase_score_retry_never_clobbers_richer_partial():
     """The exact review scenario: attempt 1 died after 3 phases, attempt
     2 died after 1 — the supervisor must keep attempt 1's line."""

@@ -27,6 +27,14 @@ WINDOW = int(os.environ.get("MB_WINDOW", 257))  # prompt 128 + decode 128 + 1
 CHUNK = 64
 
 
+def act_for(weights: str) -> str:
+    """MB_ACT mirrors BENCH_ACT/TUNE_ACT: int8 (the adopted W8A8
+    serving default) unless reverted, and only when weights are int8 —
+    shared by the microbench and tools/profile_decode so the profiler
+    can never desynchronize from the benchmark it explains."""
+    return os.environ.get("MB_ACT", "int8" if weights == "int8" else "bf16")
+
+
 def chunk_impl(params, state, *, cfg, n_steps):
 
     def step(carry, _):
@@ -57,13 +65,8 @@ def chunk_impl(params, state, *, cfg, n_steps):
 
 
 def bench(weights: str, kv: str, attn: str = "xla") -> float:
-    # MB_ACT mirrors BENCH_ACT/TUNE_ACT: int8 (the adopted W8A8 serving
-    # default) unless reverted — so a plain rerun reproduces the
-    # recorded numbers. Only applies when weights are int8.
     cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
-                     attn_impl=attn,
-                     act_dtype=os.environ.get(
-                         "MB_ACT", "int8" if weights == "int8" else "bf16"))
+                     attn_impl=attn, act_dtype=act_for(weights))
     if weights == "int8":
         # Memory-aware: 8B geometry can't materialize bf16 then quantize.
         from seldon_tpu.models.quantize import init_params_int8
@@ -99,7 +102,7 @@ def bench(weights: str, kv: str, attn: str = "xla") -> float:
     ms_per_step = 1000.0 * dt / CHUNK
     toks_per_s = SLOTS * CHUNK / dt
     print(
-        f"w={weights:5s} kv={kv:5s} attn={attn:5s} "
+        f"w={weights:5s} kv={kv:5s} act={cfg.act_dtype:5s} attn={attn:5s} "
         f"{ms_per_step:7.3f} ms/step  {toks_per_s:9.0f} tok/s",
         flush=True,
     )

@@ -18,10 +18,11 @@ from tools.microbench_decode import chunk_impl, SLOTS, WINDOW, CHUNK
 def main():
     kv = sys.argv[1] if len(sys.argv) > 1 else "int8"
     wd = sys.argv[2] if len(sys.argv) > 2 else "int8"
+    from tools.microbench_decode import act_for
+
     cfg = get_config(os.environ.get("MB_PRESET", "bench-1b"),
                      kv_cache_dtype=kv, weight_dtype=wd,
-                     act_dtype=os.environ.get(
-                         "MB_ACT", "int8" if wd == "int8" else "bf16"))
+                     act_dtype=act_for(wd))
     if wd == "int8":
         from seldon_tpu.models.quantize import init_params_int8
 
