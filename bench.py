@@ -68,6 +68,13 @@ WEIGHTS = os.environ.get("BENCH_WEIGHTS", "int8")
 # int8 weights/KV (tests/test_models.py::test_w8a8_*). BENCH_ACT=bf16
 # reverts to bf16-math matmuls.
 ACT = os.environ.get("BENCH_ACT", "int8")
+# Prefix-cache phase (opt-in): runs a shared-prefix workload against a
+# prefix_cache=True engine and records hit rate + cold-vs-warm admission
+# TTFT in detail.prefix. Off by default: the headline workload uses
+# i.i.d. random prompts where a prefix cache can only add overhead.
+PREFIX = os.environ.get("BENCH_PREFIX", "0") == "1"
+PREFIX_BLOCK = int(os.environ.get("BENCH_PREFIX_BLOCK", "16"))
+PREFIX_NREQ = int(os.environ.get("BENCH_PREFIX_NREQ", "24"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
@@ -205,6 +212,8 @@ def _phase_score(line: dict | None) -> int:
     if b:
         s += 1
     if "slo_req_s" in b:
+        s += 1
+    if "prefix" in d:
         s += 1
     if not d.get("partial"):
         s += 10
@@ -568,6 +577,84 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
     return n_req / dt, detail, sp
 
 
+def _measure_prefix(params, cfg) -> dict:
+    """Shared-prefix workload against a prefix_cache engine: hit rate,
+    tokens saved, and cold-vs-warm admission latency (TTFT).
+
+    Half the prompt is a shared block-aligned "system prompt"; requests
+    run SEQUENTIALLY so TTFT isolates admission cost (prefill + scatter)
+    from queueing. Cold rows use disjoint prefixes (every admission
+    prefills the full prompt); warm rows share the prefix, so admission
+    prefills only the suffix off the trie's retained KV."""
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    shared = (PROMPT_LEN // 2 // PREFIX_BLOCK) * PREFIX_BLOCK
+    ecfg = EngineConfig(
+        max_slots=8,
+        max_seq_len=PROMPT_LEN + 16 + 1,
+        # Two buckets: full prompts (cold) and the uncached suffix (warm).
+        prompt_buckets=(PROMPT_LEN - shared, PROMPT_LEN),
+        max_admit=4,
+        decode_chunk=DECODE_CHUNK,
+        prefix_cache=True,
+        prefix_block=PREFIX_BLOCK,
+    )
+    engine = InferenceEngine(params, cfg, ecfg)
+    engine.warmup()
+    engine.start()
+    rng = np.random.default_rng(11)
+
+    def sp(i: int) -> SamplingParams:
+        return SamplingParams(temperature=0.7, max_new_tokens=8, seed=i)
+
+    def one_ttft(prompt, i) -> float:
+        q = engine.submit(prompt, sp(i))
+        first = q.get(timeout=300)
+        ttft = first.get("ttft_ms", float("inf")) if first else float("inf")
+        while first is not None:
+            first = q.get()
+        return ttft
+
+    def prompt_row(prefix_seed: int):
+        r = np.random.default_rng(prefix_seed)
+        pre = r.integers(3, cfg.vocab_size, size=(shared,))
+        suf = rng.integers(3, cfg.vocab_size, size=(PROMPT_LEN - shared,))
+        return np.concatenate([pre, suf]).tolist()
+
+    # Dispatch warm-in (compiles are pre-paid by warmup; this pays the
+    # lazy host-side setup exactly like _measure_slo does).
+    for i in range(3):
+        one_ttft(prompt_row(10_000 + i), 900 + i)
+
+    cold = [one_ttft(prompt_row(20_000 + i), i)
+            for i in range(PREFIX_NREQ)]
+    s0 = engine.stats.snapshot()
+    one_ttft(prompt_row(7), 500)  # seed the shared prefix into the trie
+    warm = [one_ttft(prompt_row(7), 600 + i)
+            for i in range(PREFIX_NREQ)]
+    s1 = engine.stats.snapshot()
+    engine.stop()
+
+    hits = s1["prefix_hits"] - s0["prefix_hits"]
+    cold_p50 = float(np.percentile(cold, 50))
+    warm_p50 = float(np.percentile(warm, 50))
+    return {
+        "prefix_block": PREFIX_BLOCK,
+        "shared_prefix_tokens": shared,
+        "n_req": PREFIX_NREQ,
+        "hit_rate": round(hits / (PREFIX_NREQ + 1), 3),
+        "tokens_saved": int(s1["prefix_tokens_saved"]
+                            - s0["prefix_tokens_saved"]),
+        "evictions": int(s1["prefix_evictions"]),
+        "cold_p50_ttft_ms": round(cold_p50, 1),
+        "warm_p50_ttft_ms": round(warm_p50, 1),
+        "warm_speedup": round(cold_p50 / warm_p50, 2) if warm_p50 else None,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -604,6 +691,14 @@ def main() -> None:
     if SLO_ENABLED:
         emit(partial=True)  # phase checkpoint: survives an SLO-phase crash
         detail.update(_measure_slo(params, cfg, sp))
+
+    if PREFIX:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["prefix"] = _measure_prefix(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"prefix phase failed: {e!r}")
+            detail["prefix_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

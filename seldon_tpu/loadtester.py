@@ -43,7 +43,10 @@ async def _closed_loop(url_path: str, body: bytes, clients: int,
         while time.perf_counter() < stop_at:
             t0 = time.perf_counter()
             try:
-                async with session.post(url_path, data=body,
+                # Callable bodies generate a fresh payload per request
+                # (shared-prefix workloads need per-request prompts).
+                data = body() if callable(body) else body
+                async with session.post(url_path, data=data,
                                         headers=headers) as r:
                     if r.status != 200:
                         await r.read()
@@ -113,22 +116,48 @@ async def run_grpc(target: str, payload_rows, clients: int, seconds: float):
 async def run_generate(url: str, clients: int, seconds: float,
                        prompt: str = "benchmark prompt",
                        max_new_tokens: int = 32,
-                       temperature: float = 0.0):
+                       temperature: float = 0.0,
+                       shared_prefix_frac: float = 0.0,
+                       shared_prefix: str = ""):
     """LLM serving load: closed-loop /generate clients. Latency here is
     full completion time; tokens/s is the serving-throughput number (the
     engine's own TTFT gauges cover time-to-first-token). Greedy by
     default so completion lengths — and therefore tokens/s — are
-    reproducible across runs."""
+    reproducible across runs.
+
+    shared_prefix_frac > 0 switches to the SHARED-PREFIX workload: that
+    fraction of requests opens with one common system prompt (the rest
+    get per-request cold prefixes), so an engine with
+    EngineConfig.prefix_cache serves them off retained KV — watch
+    jaxserver_prefix_hits / prefix_tokens_saved move."""
     tokens = [0]
 
     async def count_tokens(r):
         out = await r.json()
         tokens[0] += int(out.get("completion_tokens", 0))
 
-    body = json.dumps({
-        "prompt": prompt, "max_new_tokens": max_new_tokens,
-        "temperature": temperature,
-    }).encode()
+    def payload(p: str) -> bytes:
+        return json.dumps({
+            "prompt": p, "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+        }).encode()
+
+    if shared_prefix_frac > 0.0:
+        # Long enough to span several prefix-cache blocks under the byte
+        # tokenizer; uniqueness lives strictly AFTER the shared part.
+        pre = shared_prefix or (
+            "You are a serving benchmark assistant. Answer tersely. " * 4
+        )
+        rng = np.random.default_rng(0)
+        uid = [0]
+
+        def body() -> bytes:
+            uid[0] += 1
+            head = (pre if rng.random() < shared_prefix_frac
+                    else f"cold prefix {uid[0]:08d}. ")
+            return payload(f"{head}{prompt} #{uid[0]}")
+    else:
+        body = payload(prompt)
     total, dt, lats, errors = await _closed_loop(
         url.rstrip("/") + "/generate", body, clients, seconds,
         on_response=count_tokens,
@@ -171,17 +200,27 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--prompt", default="benchmark prompt")
     parser.add_argument("--max-new-tokens", type=int, default=32)
     parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                        help="fraction of /generate requests opening with "
+                             "one shared system prompt (prefix-cache "
+                             "workload); 0 disables")
+    parser.add_argument("--shared-prefix", default="",
+                        help="override the shared system prompt text")
     args = parser.parse_args(argv)
 
     if args.transport == "generate":
         total, dt, lats, errors, toks = asyncio.run(
             run_generate(args.url, args.clients, args.seconds,
                          args.prompt, args.max_new_tokens,
-                         args.temperature)
+                         args.temperature, args.shared_prefix_frac,
+                         args.shared_prefix)
         )
+        extra = {"completion_tokens": toks,
+                 "tokens_per_s": round(toks / dt, 1) if dt else 0.0}
+        if args.shared_prefix_frac > 0.0:
+            extra["shared_prefix_frac"] = args.shared_prefix_frac
         report("generate", total, dt, lats, errors, args.clients,
-               extra={"completion_tokens": toks,
-                      "tokens_per_s": round(toks / dt, 1) if dt else 0.0})
+               extra=extra)
         return
     if args.transport == "rest":
         total, dt, lats, errors = asyncio.run(

@@ -494,6 +494,40 @@ def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
     return x, {"k": ks, "v": vs}, jnp.mean(aux)
 
 
+def _run_blocks_prefill_prefix(params, x, cfg, positions, inv_freq, mask,
+                               prefix_kv):
+    """Layer scan for SUFFIX prefill (prefix-cache admissions): attention
+    runs over reused prefix KV plus the fresh suffix k/v. `prefix_kv` is
+    {"k","v"[,"k_scale","v_scale"]} stacked [L, B, Hkv, Pb, (Dh)] in
+    cache storage dtype — it rides the scan as xs next to the blocks, so
+    each layer reads exactly its own [B, Hkv, Pb, Dh] slice (int8 caches
+    dequantize per layer; the scales' relative error already sits below
+    the int8 noise, see _quantize_kv). Fresh suffix k/v come back as ys
+    in cache layout, same contract as _run_blocks_prefill."""
+    quantized = "k_scale" in prefix_kv
+
+    def body(carry, xs):
+        bp, pl = xs
+        h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        pk = pl["k"].astype(q.dtype)
+        pv = pl["v"].astype(q.dtype)
+        if quantized:
+            pk = pk * pl["k_scale"][..., None].astype(q.dtype)
+            pv = pv * pl["v_scale"][..., None].astype(q.dtype)
+        # Prefix is head-major [B, Hkv, Pb, Dh]; attention wants
+        # token-major columns in front of the fresh suffix.
+        k_all = jnp.concatenate([pk.transpose(0, 2, 1, 3), k], axis=1)
+        v_all = jnp.concatenate([pv.transpose(0, 2, 1, 3), v], axis=1)
+        attn = gqa_attention(q, k_all, v_all, mask)
+        x = carry + _qdot(attn, bp, "wo", cfg)
+        x, aux = _mlp_res(x, bp, cfg, None)
+        return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), aux)
+
+    x, (ks, vs, aux) = jax.lax.scan(body, x, (params["blocks"], prefix_kv))
+    return x, {"k": ks, "v": vs}, jnp.mean(aux)
+
+
 def _run_blocks_decode(params, x, cfg, positions, inv_freq, pos, cache,
                        act_spec=None):
     """Layer scan for DECODE: the cache is read PRE-write (attention
@@ -686,6 +720,52 @@ def prefill(
     last = jnp.clip(prompt_lens - 1, 0, S - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,D]
     return _logits(params, x_last, cfg)[:, 0], cache
+
+
+def prefill_with_prefix(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, Sq] right-padded SUFFIX tokens
+    prompt_lens: jnp.ndarray,  # [B] FULL prompt lengths
+    prefix_kv: Cache,  # [L, B, Hkv, Pb, (Dh)] reused prefix, cache dtype
+    prefix_lens: jnp.ndarray,  # [B] true prefix lengths (<= Pb)
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, Cache]:
+    """Prefill that RESUMES at a position offset: runs only the uncached
+    suffix of each prompt, attending to already-computed prefix KV
+    (prefix-cache admissions, servers/engine.py).
+
+    RoPE is position-absolute, so suffix q/k rotate at their true
+    positions (prefix_len + i) and the reused prefix KV — rotated at its
+    own absolute positions when first computed — lines up exactly with a
+    cold full prefill. The mask exposes prefix columns t < prefix_len
+    plus the causal triangle over the suffix; padded prefix/suffix
+    columns are masked or land past each row's real tokens, where the
+    decode-side strict t < pos mask guarantees write-before-read.
+
+    Returns (next-token logits [B, V] at each row's last real suffix
+    token, fresh suffix KV {"k","v"} stacked [L, B, Hkv, Sq, Dh] bf16 —
+    the caller scatters prefix and suffix into the slot cache)."""
+    B, Sq = tokens.shape
+    Pb = prefix_kv["k"].shape[3]
+    x = _embed_rows(params, tokens, _dtype(cfg))
+    positions = prefix_lens[:, None] + jnp.arange(Sq)[None, :]
+    inv_freq = rope_frequencies(cfg)
+    pmask = jnp.broadcast_to(
+        jnp.arange(Pb)[None, None, :] < prefix_lens[:, None, None],
+        (B, Sq, Pb),
+    )
+    smask = jnp.broadcast_to(
+        jnp.tril(jnp.ones((Sq, Sq), dtype=bool))[None], (B, Sq, Sq)
+    )
+    mask = jnp.concatenate([pmask, smask], axis=2)
+    x, kv, _ = _run_blocks_prefill_prefix(
+        params, x, cfg, positions, inv_freq, mask, prefix_kv
+    )
+    # Last real token of the SUFFIX (admissions cap the reused prefix at
+    # prompt_len - 1, so there is always at least one suffix token).
+    last = jnp.clip(prompt_lens - prefix_lens - 1, 0, Sq - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
+    return _logits(params, x_last, cfg)[:, 0], kv
 
 
 def decode_step(

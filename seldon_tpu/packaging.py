@@ -445,6 +445,7 @@ import java.util.ArrayList;
 import java.util.LinkedHashMap;
 import java.util.List;
 import java.util.Map;
+import java.util.concurrent.Executors;
 import java.util.concurrent.atomic.AtomicLong;
 
 public final class Microservice {
@@ -461,10 +462,21 @@ public final class Microservice {
         int port = Integer.parseInt(env("PREDICTIVE_UNIT_SERVICE_PORT",
                                         "9000"));
         String model = env("MODEL_NAME", "MyModel");
-        Object params = Json.parse(env("PREDICTIVE_UNIT_PARAMETERS", "[]"));
+        Object params;
+        try {  // malformed operator-injected params must not kill boot
+            params = Json.parse(env("PREDICTIVE_UNIT_PARAMETERS", "[]"));
+        } catch (Exception e) {
+            System.err.println("bad PREDICTIVE_UNIT_PARAMETERS ("
+                    + e.getMessage() + "); continuing with []");
+            params = new ArrayList<>();
+        }
         user = Class.forName(model).getDeclaredConstructor().newInstance();
         call("init", params);
         HttpServer srv = HttpServer.create(new InetSocketAddress(port), 0);
+        // Cached thread pool: the default (calling-thread) executor
+        // serializes ALL requests, so one slow predict() would starve
+        // /live and /ready into kubelet restarts.
+        srv.setExecutor(Executors.newCachedThreadPool());
         srv.createContext("/", Microservice::handle);
         srv.start();
         System.out.println("seldon-tpu java unit " + model
@@ -487,15 +499,19 @@ public final class Microservice {
                                 : new LinkedHashMap<>();
     }
 
+    // {values, names, shape} — shape is non-null only for tensor
+    // payloads, mirroring the node/R shims' dataOf contract.
     static Object[] dataOf(Map<String, Object> msg) {
         Map<String, Object> d = asMap(msg.get("data"));
         Object names = d.containsKey("names") ? d.get("names")
                                               : new ArrayList<>();
         if (d.containsKey("ndarray"))
-            return new Object[]{d.get("ndarray"), names};
-        if (d.containsKey("tensor"))
-            return new Object[]{asMap(d.get("tensor")).get("values"), names};
-        return new Object[]{null, names};
+            return new Object[]{d.get("ndarray"), names, null};
+        if (d.containsKey("tensor")) {
+            Map<String, Object> t = asMap(d.get("tensor"));
+            return new Object[]{t.get("values"), names, t.get("shape")};
+        }
+        return new Object[]{null, names, null};
     }
 
     static Map<String, Object> outMessage(Object result,
@@ -532,8 +548,13 @@ public final class Microservice {
         Object[] dn = dataOf(msg);
         switch (verb) {
             case "predict": {
-                Object r = call("predict", dn[0], dn[1],
-                                asMap(msg.get("meta")));
+                // Copied meta (the original is echoed back untouched)
+                // carrying the tensor shape so flat `values` are
+                // reshapeable user-side.
+                Map<String, Object> meta =
+                        new LinkedHashMap<>(asMap(msg.get("meta")));
+                if (dn[2] != null) meta.put("shape", dn[2]);
+                Object r = call("predict", dn[0], dn[1], meta);
                 if (r == ABSENT)  // MODELs must implement predict — loud
                     throw new IllegalStateException(
                             "no predict(Object, List, Map) on user class");

@@ -1,0 +1,72 @@
+"""Version compat shims for the pinned jax.
+
+`jax.shard_map` graduated from `jax.experimental.shard_map` only after
+the pinned 0.4.x line, and the API moved with it (`check_rep` ->
+`check_vma`, partial-manual mode spelled `axis_names=...` instead of the
+complement `auto=...`). Every shard_map consumer (ring attention,
+pipeline parallelism) imports from HERE so the translation lives in one
+place and drops out cleanly when the pin moves.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, new kwarg names
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _NEW_API = True
+except ImportError:  # pinned 0.4.x: experimental namespace, old kwargs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+# Old partial-auto mode (`auto=...`) is broken on the pinned 0.4.x:
+# `axis_index` lowers to a PartitionId instruction the SPMD partitioner
+# rejects, and sharded manual-axis inputs trip a
+# `sharding.IsManualSubgroup()` CHECK once real auto axes exist.
+# Consumers whose specs never mention the auto axes can fall back to
+# full-manual (identical semantics, just no automatic internal sharding
+# over the auto axes) by gating `axis_names` on this flag.
+PARTIAL_AUTO = _NEW_API
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None, **kwargs):
+    """`jax.shard_map` facade accepting the NEW API's kwargs on both
+    jax lines. `axis_names` (manual axes) maps to the old API's `auto`
+    (its complement over the mesh); `check_vma` maps to `check_rep`."""
+    if _NEW_API:
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+        # Old partial-auto mode predates replication checking; it must be
+        # explicitly off or tracing raises NotImplementedError.
+        if check_vma is None:
+            check_vma = False
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def pvary(x, axis_names):
+    """Mark `x` as varying over manual axes inside a shard_map body.
+
+    The new API's varying-manual-axes (vma) typing requires the explicit
+    cast (e.g. scan carries must be loop-invariant INCLUDING their vma
+    set); the old API has no varying tracking at all (`check_rep=False`
+    above), so this is the identity there."""
+    if _NEW_API:
+        import jax
+
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    return x
+
+
+__all__ = ["shard_map", "pvary", "PARTIAL_AUTO"]

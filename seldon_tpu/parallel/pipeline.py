@@ -32,6 +32,8 @@ from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.transformer import _dtype
 from seldon_tpu.parallel import sharding as shd
+from seldon_tpu.parallel import compat
+from seldon_tpu.parallel.compat import shard_map
 
 
 def pp_param_pspecs(cfg) -> Dict[str, Any]:
@@ -118,9 +120,7 @@ def make_pipeline_forward(
         # in f32 (same CPU-backend bf16 all-reduce workaround as below) by
         # casting AFTER the pcast.
         def pvary(shape, dtype):
-            z = jax.lax.pcast(
-                jnp.zeros(shape, jnp.float32), ("pp",), to="varying"
-            )
+            z = compat.pvary(jnp.zeros(shape, jnp.float32), ("pp",))
             return z.astype(dtype)
 
         dt = _dtype(cfg)
@@ -178,12 +178,17 @@ def make_pipeline_forward(
         aux_mean = jax.lax.psum(aux_total, "pp") / (cfg.n_layers * M)
         return hidden.reshape(-1, *hidden.shape[2:]), aux_mean
 
-    staged_sm = jax.shard_map(
+    # Partial-manual ('pp' manual, dp/tp/... auto) lets GSPMD shard the
+    # stage bodies internally; the pinned 0.4.x partial-auto mode is
+    # broken (see compat.PARTIAL_AUTO), and since no spec here mentions
+    # an auto axis, full-manual is semantically identical there.
+    staged_sm = shard_map(
         staged,
         mesh=mesh,
         in_specs=(block_manual_specs, P(), P(), P(), P()),
         out_specs=(P(), P()),
-        axis_names=frozenset({"pp"}),
+        axis_names=frozenset({"pp"}) if compat.PARTIAL_AUTO else None,
+        check_vma=False,
     )
 
     def fwd(params, tokens):

@@ -19,8 +19,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from seldon_tpu.parallel.compat import shard_map
 
 NEG_INF = -1e30
 

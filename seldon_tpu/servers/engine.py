@@ -67,6 +67,15 @@ class EngineConfig:
     # per power-of-two rung (min_chunk..decode_chunk).
     adaptive_chunk: bool = True
     min_chunk: int = 4
+    # Prompt prefix KV cache (opt-in): reuse device-resident KV of
+    # previously-seen block-aligned prompt prefixes so admissions prefill
+    # only the uncached suffix (servers/prefix_cache.py). False keeps the
+    # admission path byte-identical to the pre-prefix engine. Single-
+    # process meshes only (the index is host-side; multi-process SPMD
+    # dispatch must not depend on per-host trie state).
+    prefix_cache: bool = False
+    prefix_block: int = 16  # trie granularity; reuse is block-aligned
+    prefix_cache_bytes: int = 256 << 20  # HBM budget for retained KV
 
 
 @dataclasses.dataclass
@@ -85,6 +94,11 @@ class _Request:
     # guarantees the row really is frozen once the budget is spent.
     expected: int = 0
     finished: bool = False
+    # Prefix-cache state: match length (None until looked up; multiple of
+    # prefix_block) and the pinned trie path, held until _complete so a
+    # live slot's prefix can never be evicted.
+    prefix_len: Optional[int] = None
+    prefix_handle: Any = None
 
 
 class EngineStats:
@@ -100,6 +114,12 @@ class EngineStats:
         # length, the knob the occupancy policy is turning.
         self.decode_dispatches = 0
         self.decode_steps = 0
+        # Prefix-cache observability: admissions that reused cached KV,
+        # prompt tokens whose prefill was skipped, and trie nodes evicted
+        # under the byte budget.
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.prefix_evictions = 0
 
     def snapshot(self) -> Dict[str, float]:
         with self.lock:
@@ -114,6 +134,9 @@ class EngineStats:
                 ),
                 "decode_dispatches": self.decode_dispatches,
                 "decode_steps": self.decode_steps,
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_saved": self.prefix_tokens_saved,
+                "prefix_evictions": self.prefix_evictions,
             }
 
 
@@ -182,6 +205,42 @@ class InferenceEngine:
                               ring_mesh=self._ring_mesh),
             donate_argnums=(1,),
         )
+        # Prefix KV cache (opt-in, single-process only — the trie is
+        # host-side state, and multi-process SPMD dispatch decisions must
+        # be identical on every host). When enabled, COLD admissions run
+        # through a variant that also returns the freshly-computed
+        # cache-dtype KV (for trie insertion) and WARM admissions run the
+        # suffix-only path; self._jit_admit itself stays untouched, so
+        # prefix_cache=False keeps today's admission path byte-identical.
+        self._prefix = None
+        self._jit_admit_sub = None
+        self._jit_admit_prefix = None
+        if self.ecfg.prefix_cache:
+            if jax.process_count() > 1:
+                logger.warning(
+                    "prefix_cache disabled: host-side KV index requires a "
+                    "single-process mesh"
+                )
+            else:
+                from seldon_tpu.servers.prefix_cache import PrefixIndex
+
+                self._prefix = PrefixIndex(
+                    block=self.ecfg.prefix_block,
+                    byte_budget=self.ecfg.prefix_cache_bytes,
+                )
+                self._jit_admit_sub = jax.jit(
+                    functools.partial(
+                        self._admit_impl, cfg=self.cfg, mesh=mesh,
+                        ring_mesh=self._ring_mesh, return_sub=True,
+                    ),
+                    donate_argnums=(1,),
+                )
+                self._jit_admit_prefix = jax.jit(
+                    functools.partial(
+                        self._admit_prefix_impl, cfg=self.cfg, mesh=mesh,
+                    ),
+                    donate_argnums=(1,),
+                )
         # Chunk-length ladder: exactly the three rungs the policy uses
         # (min / geometric mid / top) — every rung costs a full chunk
         # compile, so no speculative intermediates.
@@ -242,6 +301,7 @@ class InferenceEngine:
     def _admit_impl(
         params, state, toks, plens, seeds, temps, top_ks, top_ps,
         max_news, slots, *, cfg, mesh=None, ring_mesh=None,
+        return_sub=False,
     ):
         """Fused admission: prefill [G, Sb], scatter into cache slots, sample
         first tokens, arm slot state. One dispatch, no host sync.
@@ -295,7 +355,84 @@ class InferenceEngine:
         first, first_done = InferenceEngine._replicate(
             mesh, first, first_done
         )
+        if return_sub:
+            # Prefix-cache insertion path: `sub` already holds the
+            # cache-dtype KV writes [L, G, Hkv, Sb, (Dh)] the host slices
+            # into trie blocks.
+            return new_state, first, first_done, sub
         return new_state, first, first_done
+
+    @staticmethod
+    def _admit_prefix_impl(
+        params, state, toks, plens, prefix_lens, prefix_kv, seeds, temps,
+        top_ks, top_ps, max_news, slots, *, cfg, mesh=None,
+    ):
+        """Fused WARM admission: suffix-only prefill attending to reused
+        prefix KV, prefix + suffix scattered into the slot cache, first
+        tokens sampled, slot state armed — the prefix-cache twin of
+        _admit_impl.
+
+        `toks` holds ONLY each prompt's uncached suffix [G, Sq]; `plens`
+        are FULL prompt lengths, so the first-token sampling key
+        fold_in(key(seed), plen) matches the cold path bit-for-bit.
+        `prefix_kv` arrives in cache storage dtype [L, G, Hkv, Pb, (Dh)]
+        (gathered host-side from the trie, zero-padded past each row's
+        prefix_len — the padded tail is overwritten by the suffix scatter
+        below, and decode's strict t < pos mask never reads past-plen
+        garbage before it is rewritten)."""
+        G, Sq = toks.shape
+        logits, kv = transformer.prefill_with_prefix(
+            params, toks, plens, prefix_kv, prefix_lens, cfg
+        )
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(seeds, plens)
+        first = sample_per_row(logits, keys, temps, top_ks, top_ps)
+
+        cache = state["cache"]
+        Smax = cache["k"].shape[3]
+        first_done = (
+            (first == cfg.eos_token_id)
+            | (max_news <= 1)
+            | (plens + 1 >= Smax)
+        )
+        if cfg.kv_cache_dtype == "int8":
+            kq, ks = transformer._quantize_kv(kv["k"])
+            vq, vs = transformer._quantize_kv(kv["v"])
+            writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+        else:
+            dt = cache["k"].dtype
+            writes = {"k": kv["k"].astype(dt), "v": kv["v"].astype(dt)}
+        Pb = prefix_kv["k"].shape[3]
+        # Suffix rows land at absolute positions prefix_len + i; rows past
+        # the cache window drop out of the scatter (jax default OOB mode).
+        spos = prefix_lens[:, None] + jnp.arange(Sq)[None, :]  # [G, Sq]
+        new_cache = {}
+        for key in cache:
+            c = cache[key].at[:, slots, :, :Pb].set(
+                prefix_kv[key].astype(cache[key].dtype)
+            )
+            # Advanced indices (slots, spos) broadcast to [G, Sq] and land
+            # in front: update operand is writes[key] [L, G, Hkv, Sq, ...]
+            # with G and Sq moved to the front.
+            new_cache[key] = c.at[:, slots[:, None], :, spos].set(
+                jnp.moveaxis(writes[key], (1, 3), (0, 1))
+            )
+        new_state = {
+            "cache": new_cache,
+            "last_tok": state["last_tok"].at[slots].set(first),
+            "pos": state["pos"].at[slots].set(plens),
+            "active": state["active"].at[slots].set(~first_done),
+            "temp": state["temp"].at[slots].set(temps),
+            "top_k": state["top_k"].at[slots].set(top_ks),
+            "top_p": state["top_p"].at[slots].set(top_ps),
+            "seeds": state["seeds"].at[slots].set(seeds),
+            "remaining": state["remaining"].at[slots].set(max_news - 1),
+        }
+        first, first_done = InferenceEngine._replicate(
+            mesh, first, first_done
+        )
+        return new_state, first, first_done, writes
 
     @staticmethod
     def _chunk_impl(params, state, *, cfg, n_steps, mesh=None):
@@ -434,10 +571,13 @@ class InferenceEngine:
         while g <= self._max_admit:
             sizes.append(g)
             g *= 2
+        admit = self._jit_admit_sub if self._prefix is not None \
+            else self._jit_admit
+        n_warm = 0
         for Sb in self._buckets:
             for G in sizes:
                 # max_new=1 -> rows are first_done; no slot state leaks.
-                self._state, _, _ = self._jit_admit(
+                out = admit(
                     self.params,
                     self._state,
                     jnp.zeros((G, Sb), jnp.int32),
@@ -449,6 +589,30 @@ class InferenceEngine:
                     jnp.ones((G,), jnp.int32),
                     jnp.arange(G, dtype=jnp.int32),
                 )
+                self._state = out[0]
+                if self._prefix is not None:
+                    # Warm (prefix-hit) variants: one per
+                    # (prefix bucket, suffix bucket, G). Zero prefix KV +
+                    # max_new=1 keeps it a pure compile.
+                    for Pb in self._buckets:
+                        if Pb >= self.ecfg.max_seq_len:
+                            continue
+                        pkv = transformer.init_cache(self.cfg, G, Pb)
+                        self._state, _, _, _ = self._jit_admit_prefix(
+                            self.params,
+                            self._state,
+                            jnp.zeros((G, Sb), jnp.int32),
+                            jnp.full((G,), Pb + 1, jnp.int32),
+                            jnp.full((G,), Pb, jnp.int32),
+                            pkv,
+                            jnp.zeros((G,), jnp.uint32),
+                            jnp.ones((G,), jnp.float32),
+                            jnp.zeros((G,), jnp.int32),
+                            jnp.ones((G,), jnp.float32),
+                            jnp.ones((G,), jnp.int32),
+                            jnp.arange(G, dtype=jnp.int32),
+                        )
+                        n_warm += 1
         # All slots inactive: pure compile + masked no-op writes, one per
         # chunk-ladder rung.
         for n in self._chunk_sizes:
@@ -457,8 +621,9 @@ class InferenceEngine:
             )
         jax.block_until_ready(self._state["last_tok"])
         logger.info(
-            "engine warmed: %d admission variants + %d decode chunk sizes",
-            len(self._buckets) * len(sizes), len(self._chunk_sizes),
+            "engine warmed: %d admission variants (+%d prefix-warm) + %d "
+            "decode chunk sizes",
+            len(self._buckets) * len(sizes), n_warm, len(self._chunk_sizes),
         )
 
     # --- scheduler loop -----------------------------------------------------
@@ -468,6 +633,32 @@ class InferenceEngine:
             if n <= b:
                 return b
         return self.ecfg.max_seq_len
+
+    def _admit_key(self, req: _Request) -> Tuple[int, int]:
+        """(suffix bucket, prefix bucket) for grouping admissions. Cold
+        requests (no prefix cache / no match) key as (full bucket, 0) —
+        the pre-prefix grouping exactly. The trie lookup runs once per
+        request and pins the matched path; the match is capped at
+        plen - 1 so at least one suffix token remains to produce the
+        next-token logits."""
+        if self._prefix is None:
+            return self._bucket(len(req.tokens)), 0
+        if req.prefix_len is None:
+            handle = self._prefix.lookup(
+                req.tokens, max_len=len(req.tokens) - 1
+            )
+            req.prefix_handle = handle
+            req.prefix_len = handle.match_len
+            if handle.match_len:
+                with self.stats.lock:
+                    self.stats.prefix_hits += 1
+                    self.stats.prefix_tokens_saved += handle.match_len
+        if req.prefix_len:
+            return (
+                self._bucket(len(req.tokens) - req.prefix_len),
+                self._bucket(req.prefix_len),
+            )
+        return self._bucket(len(req.tokens)), 0
 
     def _dispatch_admits(self) -> List[Tuple[List[_Request], Any, Any]]:
         """Admit FIFO prefix runs of same-bucket waiting requests as batched
@@ -479,17 +670,17 @@ class InferenceEngine:
                 break
         admits: List[Tuple[List[_Request], Any, Any]] = []
         while self._free and self._waiting:
-            Sb = self._bucket(len(self._waiting[0].tokens))
+            key = self._admit_key(self._waiting[0])
             max_g = min(self._max_admit, len(self._free))
             group: List[_Request] = []
             while (
                 len(group) < max_g
                 and self._waiting
-                and self._bucket(len(self._waiting[0].tokens)) == Sb
+                and self._admit_key(self._waiting[0]) == key
             ):
                 group.append(self._waiting.popleft())
             try:
-                admits.append(self._dispatch_admit_group(group, Sb))
+                admits.append(self._dispatch_admit_group(group, *key))
             except Exception as e:  # bad batch must not kill the loop
                 logger.exception(
                     "admission failed for requests %s",
@@ -505,14 +696,18 @@ class InferenceEngine:
         return admits
 
     def _dispatch_admit_group(
-        self, group: List[_Request], Sb: int
+        self, group: List[_Request], Sb: int, Pb: int = 0
     ) -> Tuple[List[_Request], Any, Any]:
         """Build host arrays for `group`, dispatch the fused admission.
 
         G is padded up to a power of two by replicating the last request
         (identical slot + data, so the duplicate scatter writes are
         harmless), bounding compile variants to log2(max_admit)+1 per
-        bucket."""
+        bucket. Pb > 0 is a prefix-cache WARM group: `Sb` buckets the
+        uncached suffix, `Pb` the reused prefix, and the token array
+        carries only suffixes (so the jit variant is keyed on
+        (Pb, Sb, G) — one compile per prefix bucket, mirroring the
+        prompt-bucket discipline)."""
         G = len(group)
         Gp = 1
         while Gp < G:
@@ -522,6 +717,7 @@ class InferenceEngine:
             req.expected = 1  # the admission samples the first token
         toks = np.full((Gp, Sb), self.cfg.pad_token_id, np.int32)
         plens = np.empty((Gp,), np.int32)
+        pref_lens = np.empty((Gp,), np.int32)
         seeds = np.empty((Gp,), np.uint32)
         temps = np.empty((Gp,), np.float32)
         top_ks = np.empty((Gp,), np.int32)
@@ -531,31 +727,95 @@ class InferenceEngine:
         for i in range(Gp):
             req = group[min(i, G - 1)]
             sp = req.params
-            toks[i, : len(req.tokens)] = req.tokens
+            off = req.prefix_len if Pb else 0
+            toks[i, : len(req.tokens) - off] = req.tokens[off:]
             plens[i] = len(req.tokens)
+            pref_lens[i] = off
             seeds[i] = np.uint32(int(sp.seed) & 0xFFFFFFFF)
             temps[i] = sp.temperature
             top_ks[i] = sp.top_k
             top_ps[i] = sp.top_p
             max_news[i] = sp.max_new_tokens
             slots[i] = req.slot
-        self._state, first, first_done = self._jit_admit(
-            self.params,
-            self._state,
-            jnp.asarray(toks),
-            jnp.asarray(plens),
-            jnp.asarray(seeds),
-            jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
-            jnp.asarray(max_news),
-            jnp.asarray(slots),
-        )
+        if Pb:
+            # Per-row device gather of the pinned trie path, zero-padded
+            # to the prefix bucket and stacked on the batch axis (dim 1
+            # of the [L, G, Hkv, Pb, ...] cache layout).
+            rows = [
+                self._prefix.gather(group[min(i, G - 1)].prefix_handle, Pb)
+                for i in range(Gp)
+            ]
+            prefix_kv = {
+                key: jnp.stack([r[key] for r in rows], axis=1)
+                for key in rows[0]
+            }
+            self._state, first, first_done, writes = self._jit_admit_prefix(
+                self.params,
+                self._state,
+                jnp.asarray(toks),
+                jnp.asarray(plens),
+                jnp.asarray(pref_lens),
+                prefix_kv,
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
+                jnp.asarray(top_ks),
+                jnp.asarray(top_ps),
+                jnp.asarray(max_news),
+                jnp.asarray(slots),
+            )
+        else:
+            admit = self._jit_admit_sub if self._prefix is not None \
+                else self._jit_admit
+            out = admit(
+                self.params,
+                self._state,
+                jnp.asarray(toks),
+                jnp.asarray(plens),
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
+                jnp.asarray(top_ks),
+                jnp.asarray(top_ps),
+                jnp.asarray(max_news),
+                jnp.asarray(slots),
+            )
+            if self._prefix is not None:
+                self._state, first, first_done, writes = out
+            else:
+                self._state, first, first_done = out
+                writes = None
         # Register rows now so an error path can fail them cleanly; the
         # active mirror is armed at boundary processing.
         for req in group:
             self._slots[req.slot] = req
+        if self._prefix is not None:
+            self._insert_prompt_kv(group, writes, warm=bool(Pb))
         return group, first, first_done
+
+    def _insert_prompt_kv(self, group: List[_Request], writes: Dict[str, Any],
+                          warm: bool) -> None:
+        """Insert each admitted prompt's KV into the prefix trie. `writes`
+        holds cache-dtype KV [L, G(padded), Hkv, S, ...] — full prompts
+        for cold groups, uncached suffixes for warm ones (warm block
+        spans are rebased by the row's prefix_len; the prefix blocks
+        themselves already live in the trie, pinned by the row's handle,
+        so get_span is never asked for them). Insertion extends each
+        handle's pin over the request's own path — a live slot keeps its
+        whole prompt KV evict-proof."""
+        for i, req in enumerate(group):
+            off = req.prefix_len if warm else 0
+
+            def get_span(s, e, i=i, off=off):
+                return {
+                    key: writes[key][:, i, :, s - off:e - off]
+                    for key in writes
+                }
+
+            evicted = self._prefix.insert(
+                req.tokens, get_span, handle=req.prefix_handle
+            )
+            if evicted:
+                with self.stats.lock:
+                    self.stats.prefix_evictions += evicted
 
     def _process_admits(
         self,
@@ -616,6 +876,11 @@ class InferenceEngine:
         if req.finished:
             return
         req.finished = True
+        if req.prefix_handle is not None and self._prefix is not None:
+            # Unpin the trie path — the slot no longer depends on it, so
+            # LRU eviction may reclaim it under budget pressure.
+            self._prefix.release(req.prefix_handle)
+            req.prefix_handle = None
         req.out.put(None)
         slot = req.slot
         if 0 <= slot < len(self._slots) and self._slots[slot] is req:

@@ -51,6 +51,8 @@ class JAXServer(SeldonComponent):
         weight_dtype: str = "",
         act_dtype: str = "",
         mesh_sp: int = 0,
+        prefix_cache: int = -1,
+        prefix_cache_mb: int = 0,
     ):
         self.model_uri = model_uri
         self.preset = preset
@@ -75,6 +77,15 @@ class JAXServer(SeldonComponent):
         # the weights are int8 — selected like weight_dtype (unit
         # parameter / ACT_DTYPE env).
         self.act_dtype = act_dtype or _os.environ.get("ACT_DTYPE", "")
+        # Prompt prefix KV reuse (servers/engine.py prefix cache): unit
+        # parameter, or PREFIX_CACHE=1 / PREFIX_CACHE_MB env. -1 / 0 =
+        # follow the env (default off).
+        if int(prefix_cache) < 0:
+            prefix_cache = int(_os.environ.get("PREFIX_CACHE", "0") or 0)
+        self.prefix_cache = bool(int(prefix_cache))
+        self.prefix_cache_mb = int(
+            prefix_cache_mb or _os.environ.get("PREFIX_CACHE_MB", "0") or 0
+        )
         self._loaded = False
         self._load_lock = threading.Lock()
         self.engine: Optional[InferenceEngine] = None
@@ -159,6 +170,19 @@ class JAXServer(SeldonComponent):
                 import dataclasses as _dc
 
                 cfg = _dc.replace(cfg, act_dtype=self.act_dtype)
+            if cfg.act_dtype == "int8" and self.model_uri:
+                # Real (trained) checkpoints carry activation outliers in
+                # the down-projection inputs that per-token int8 clips —
+                # random-init presets don't show this, so a bench pass
+                # proves nothing about quality. W8A8 a trained model only
+                # with an accuracy eval in hand.
+                logger.warning(
+                    "act_dtype=int8 (W8A8) enabled for loaded checkpoint "
+                    "%s: down-proj activation outliers can degrade output "
+                    "quality — validate accuracy before serving traffic "
+                    "(weights-only int8 is the safe default)",
+                    self.model_uri,
+                )
             if cfg.weight_dtype == "int8":
                 from seldon_tpu.models.quantize import quantize_params
 
@@ -169,6 +193,11 @@ class JAXServer(SeldonComponent):
             buckets = tuple(
                 b for b in (32, 128, 512, 1024, 2048, 4096) if b <= seq
             ) or (seq,)
+            ekw: Dict[str, Any] = {}
+            if self.prefix_cache:
+                ekw["prefix_cache"] = True
+                if self.prefix_cache_mb:
+                    ekw["prefix_cache_bytes"] = self.prefix_cache_mb << 20
             self.engine = InferenceEngine(
                 params,
                 cfg,
@@ -176,6 +205,7 @@ class JAXServer(SeldonComponent):
                     max_slots=self.max_slots,
                     max_seq_len=seq,
                     prompt_buckets=buckets,
+                    **ekw,
                 ),
                 mesh=mesh,
             )
@@ -375,6 +405,12 @@ class JAXServer(SeldonComponent):
              "value": float(s["decode_dispatches"])},
             {"type": "GAUGE", "key": "jaxserver_decode_steps",
              "value": float(s["decode_steps"])},
+            {"type": "GAUGE", "key": "jaxserver_prefix_hits",
+             "value": float(s["prefix_hits"])},
+            {"type": "GAUGE", "key": "jaxserver_prefix_tokens_saved",
+             "value": float(s["prefix_tokens_saved"])},
+            {"type": "GAUGE", "key": "jaxserver_prefix_evictions",
+             "value": float(s["prefix_evictions"])},
         ]
 
     def tags(self) -> Dict:

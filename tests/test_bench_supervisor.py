@@ -68,6 +68,35 @@ def test_build_act_dtype_gating(monkeypatch):
     assert cfg3.weight_dtype == "int8" and cfg3.act_dtype == "bf16"
 
 
+def test_bench_prefix_env_gating(monkeypatch):
+    """BENCH_PREFIX is opt-in (the headline workload is i.i.d. random
+    prompts where a prefix cache only adds overhead) and its block/nreq
+    knobs flow through."""
+    monkeypatch.delenv("BENCH_PREFIX", raising=False)
+    monkeypatch.delenv("BENCH_PREFIX_BLOCK", raising=False)
+    monkeypatch.delenv("BENCH_PREFIX_NREQ", raising=False)
+    b = _load_bench()
+    assert b.PREFIX is False
+    monkeypatch.setenv("BENCH_PREFIX", "1")
+    monkeypatch.setenv("BENCH_PREFIX_BLOCK", "32")
+    monkeypatch.setenv("BENCH_PREFIX_NREQ", "8")
+    b2 = _load_bench()
+    assert b2.PREFIX is True
+    assert b2.PREFIX_BLOCK == 32 and b2.PREFIX_NREQ == 8
+
+
+def test_phase_score_counts_prefix_phase():
+    """A checkpoint that captured the prefix phase must outrank one that
+    didn't — and a final record still beats any partial."""
+    b = _load_bench()
+    base = {"metric": "m", "value": 1.0, "detail": {"partial": True}}
+    withp = {"metric": "m", "value": 1.0,
+             "detail": {"partial": True, "prefix": {"hit_rate": 0.96}}}
+    final = {"metric": "m", "value": 1.0, "detail": {}}
+    assert b._phase_score(withp) > b._phase_score(base)
+    assert b._phase_score(final) > b._phase_score(withp)
+
+
 def test_phase_score_retry_never_clobbers_richer_partial():
     """The exact review scenario: attempt 1 died after 3 phases, attempt
     2 died after 1 — the supervisor must keep attempt 1's line."""

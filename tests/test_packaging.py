@@ -123,6 +123,18 @@ def test_node_shim_boots_if_node_available(tmp_path):
         proc.kill()
 
 
+def test_java_shim_hardening_rendered(tmp_path):
+    """The generated Java source carries the robustness fixes: request
+    concurrency (a slow predict() must not starve /live and /ready),
+    tensor shape surfaced like the node/R shims, and malformed
+    PREDICTIVE_UNIT_PARAMETERS tolerated at boot."""
+    out = package_model(str(tmp_path), "MyModel", language="java")
+    src = open(out["microservice_java"]).read()
+    assert "Executors.newCachedThreadPool()" in src
+    assert "bad PREDICTIVE_UNIT_PARAMETERS" in src
+    assert 'get("shape")' in src and 'put("shape"' in src
+
+
 def test_java_shim_compiles_and_boots_if_jdk_available(tmp_path):
     """Full compile + boot test of the java shim when a JDK exists
     (skipped in images without one — render is still pinned by
@@ -136,6 +148,8 @@ def test_java_shim_compiles_and_boots_if_jdk_available(tmp_path):
         "import java.util.*;\n"
         "public class MyModel {\n"
         "    public Object predict(Object data, List names, Map meta) {\n"
+        "        if (meta != null && meta.containsKey(\"shape\"))\n"
+        "            return meta.get(\"shape\");\n"
         "        List<Object> out = new ArrayList<>();\n"
         "        for (Object row : (List<?>) data) {\n"
         "            List<Object> r = new ArrayList<>();\n"
@@ -155,7 +169,10 @@ def test_java_shim_compiles_and_boots_if_jdk_available(tmp_path):
         check=True, capture_output=True, text=True)
     env = dict(os.environ)
     env.update({"MODEL_NAME": "MyModel",
-                "PREDICTIVE_UNIT_SERVICE_PORT": "0"})
+                "PREDICTIVE_UNIT_SERVICE_PORT": "0",
+                # Malformed on purpose: boot must survive it (shim
+                # falls back to []).
+                "PREDICTIVE_UNIT_PARAMETERS": "{not json"})
     proc = subprocess.Popen([java, "-cp", str(classes), "Microservice"],
                             env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
@@ -172,6 +189,14 @@ def test_java_shim_compiles_and_boots_if_jdk_available(tmp_path):
         r = rq.post(f"http://127.0.0.1:{port}/api/v0.1/route",
                     json={"data": {"ndarray": [[1]]}}, timeout=10)
         assert r.json()["data"]["ndarray"] == [[-1]]
+        # Tensor shape rides into predict's meta (node/R shim parity);
+        # the test model echoes it back when present.
+        r = rq.post(f"http://127.0.0.1:{port}/predict",
+                    json={"data": {"tensor": {"shape": [2, 2],
+                                              "values": [1, 2, 3, 4]}}},
+                    timeout=10)
+        assert r.status_code == 200
+        assert r.json()["data"]["ndarray"] == [2, 2]
     finally:
         proc.kill()
 
