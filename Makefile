@@ -1,5 +1,8 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e bench bench-orchestrator native native-tsan ci
+.PHONY: proto test test-e2e tier1 bench bench-orchestrator native native-tsan ci
+
+# tier1 uses PIPESTATUS / pipefail (bash-isms).
+tier1: SHELL := /bin/bash
 
 proto:
 	protoc --python_out=seldon_tpu/proto -I seldon_tpu/proto seldon_tpu/proto/prediction.proto
@@ -12,6 +15,17 @@ test:
 
 test-e2e:
 	python -m pytest tests/ -x -q -m e2e
+
+# The ROADMAP.md tier-1 verify line, verbatim: CPU-pinned, no -x (full
+# count), log at /tmp/_t1.log, prints DOTS_PASSED for the driver.
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
 
 bench:
 	python bench.py

@@ -75,6 +75,14 @@ ACT = os.environ.get("BENCH_ACT", "int8")
 PREFIX = os.environ.get("BENCH_PREFIX", "0") == "1"
 PREFIX_BLOCK = int(os.environ.get("BENCH_PREFIX_BLOCK", "16"))
 PREFIX_NREQ = int(os.environ.get("BENCH_PREFIX_NREQ", "24"))
+# Chunked-prefill phase (opt-in): p99 inter-token latency of short
+# decode streams while ONE long-prompt interloper arrives mid-decode,
+# measured with chunked_prefill off (the interloper's whole prefill
+# stalls every stream) vs on (bounded chunks interleave with decode).
+# Recorded in detail.chunked.
+CHUNKED = os.environ.get("BENCH_CHUNKED", "0") == "1"
+CHUNKED_STREAMS = int(os.environ.get("BENCH_CHUNKED_STREAMS", "6"))
+CHUNKED_LONG_X = int(os.environ.get("BENCH_CHUNKED_LONG_X", "8"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
@@ -214,6 +222,8 @@ def _phase_score(line: dict | None) -> int:
     if "slo_req_s" in b:
         s += 1
     if "prefix" in d:
+        s += 1
+    if "chunked" in d:
         s += 1
     if not d.get("partial"):
         s += 10
@@ -655,6 +665,116 @@ def _measure_prefix(params, cfg) -> dict:
     }
 
 
+def _measure_chunked(params, cfg) -> dict:
+    """Stall-free scheduling phase: CHUNKED_STREAMS short-prompt decode
+    streams run steadily while ONE long prompt (CHUNKED_LONG_X x
+    PROMPT_LEN tokens) arrives mid-decode. Client-side burst gaps after
+    the interloper's arrival are the tail-ITL signal: uninterleaved, the
+    whole long prefill runs before the next decode chunk (one gap spike
+    ~ full prefill time per stream); chunked, at most
+    dispatch_token_budget prefill tokens separate consecutive decode
+    chunks, so the spike is bounded by one chunk. Same model, same
+    traffic, chunked_prefill off vs on."""
+    import queue as _q  # noqa: F401 — engine queues drive the streams
+    import threading
+
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    long_len = CHUNKED_LONG_X * PROMPT_LEN
+    new_toks = max(32, NEW_TOKENS)
+    rng = np.random.default_rng(17)
+    shorts = [
+        rng.integers(3, cfg.vocab_size, size=(PROMPT_LEN,)).tolist()
+        for _ in range(CHUNKED_STREAMS)
+    ]
+    long_prompt = rng.integers(3, cfg.vocab_size, size=(long_len,)).tolist()
+
+    def run(chunked: bool) -> float:
+        ecfg = EngineConfig(
+            max_slots=CHUNKED_STREAMS + 2,
+            max_seq_len=long_len + new_toks + 1,
+            prompt_buckets=(PROMPT_LEN, long_len),
+            max_admit=4,
+            decode_chunk=4,
+            adaptive_chunk=False,  # fixed cadence isolates the stall
+            chunked_prefill=chunked,
+            prefill_chunk=PROMPT_LEN,
+            dispatch_token_budget=PROMPT_LEN,
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+        engine.warmup()
+        engine.start()
+        gaps: list = []  # (wall_time, gap_s) per burst, short streams
+        glock = threading.Lock()
+        first_burst = threading.Barrier(CHUNKED_STREAMS + 1)
+
+        def consume(q):
+            last = None
+            waited = False
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if "error" in item:
+                    raise RuntimeError(item["error"])
+                now = time.perf_counter()
+                if last is not None and item["tokens"]:
+                    with glock:
+                        gaps.append((now, now - last))
+                last = now
+                if not waited:
+                    waited = True
+                    first_burst.wait(timeout=300)
+
+        threads = []
+        for i, p in enumerate(shorts):
+            q = engine.submit(
+                p, SamplingParams(temperature=0.0, max_new_tokens=new_toks,
+                                  seed=i)
+            )
+            t = threading.Thread(target=consume, args=(q,), daemon=True)
+            t.start()
+            threads.append(t)
+        # Every stream has its first token: all are mid-decode when the
+        # interloper lands — its prefill cost hits live streams only.
+        first_burst.wait(timeout=300)
+        t_long = time.perf_counter()
+        lq = engine.submit(
+            long_prompt,
+            SamplingParams(temperature=0.0, max_new_tokens=8, seed=99),
+        )
+        for t in threads:
+            t.join(timeout=300)
+        while lq.get(timeout=300) is not None:
+            pass
+        snap = engine.stats.snapshot()
+        engine.stop()
+        tail = [g for ts, g in gaps if ts >= t_long]
+        run.last_snap = snap  # engine-side counters for the report
+        return 1000.0 * float(np.percentile(tail or [0.0], 99))
+
+    base_p99 = run(chunked=False)
+    chunked_p99 = run(chunked=True)
+    snap = run.last_snap
+    return {
+        "streams": CHUNKED_STREAMS,
+        "long_prompt_tokens": long_len,
+        "prefill_chunk": PROMPT_LEN,
+        "dispatch_token_budget": PROMPT_LEN,
+        "baseline_p99_itl_ms": round(base_p99, 1),
+        "chunked_p99_itl_ms": round(chunked_p99, 1),
+        "p99_itl_speedup": (
+            round(base_p99 / chunked_p99, 2) if chunked_p99 else None
+        ),
+        "prefill_chunks": int(snap["prefill_chunks"]),
+        "budget_utilization": round(float(snap["budget_utilization"]), 3),
+        "engine_itl_p99_ms": float(snap["itl_p99_ms"]),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -699,6 +819,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"prefix phase failed: {e!r}")
             detail["prefix_error"] = str(e)
+
+    if CHUNKED:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["chunked"] = _measure_chunked(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"chunked phase failed: {e!r}")
+            detail["chunked_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

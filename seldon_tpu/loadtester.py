@@ -53,7 +53,9 @@ async def _closed_loop(url_path: str, body: bytes, clients: int,
                         errors[0] += 1
                         continue
                     if on_response is not None:
-                        await on_response(r)
+                        # t0 lets transports time INSIDE the response
+                        # (streaming TTFT / inter-chunk gaps).
+                        await on_response(r, t0)
                     else:
                         await r.read()
             except Exception:
@@ -118,12 +120,19 @@ async def run_generate(url: str, clients: int, seconds: float,
                        max_new_tokens: int = 32,
                        temperature: float = 0.0,
                        shared_prefix_frac: float = 0.0,
-                       shared_prefix: str = ""):
-    """LLM serving load: closed-loop /generate clients. Latency here is
-    full completion time; tokens/s is the serving-throughput number (the
-    engine's own TTFT gauges cover time-to-first-token). Greedy by
-    default so completion lengths — and therefore tokens/s — are
+                       shared_prefix: str = "",
+                       stream: bool = True):
+    """LLM serving load: closed-loop generation clients. Latency is full
+    completion time; tokens/s is the serving-throughput number. Greedy
+    by default so completion lengths — and therefore tokens/s — are
     reproducible across runs.
+
+    stream=True (default) drives /generate_stream (NDJSON, one line per
+    decode-chunk burst) and records per-stream TTFT (request send ->
+    first line) and inter-token latency (gap between consecutive lines,
+    divided by the tokens the later line carried) — the numbers that
+    make a prefill stall visible. stream=False reverts to the unary
+    /generate endpoint.
 
     shared_prefix_frac > 0 switches to the SHARED-PREFIX workload: that
     fraction of requests opens with one common system prompt (the rest
@@ -131,10 +140,33 @@ async def run_generate(url: str, clients: int, seconds: float,
     EngineConfig.prefix_cache serves them off retained KV — watch
     jaxserver_prefix_hits / prefix_tokens_saved move."""
     tokens = [0]
+    ttfts: List[float] = []
+    itls: List[float] = []
 
-    async def count_tokens(r):
+    async def count_tokens(r, t0):
         out = await r.json()
         tokens[0] += int(out.get("completion_tokens", 0))
+
+    async def consume_stream(r, t0):
+        last = None
+        n_total = 0
+        async for line in r.content:
+            if not line.strip():
+                continue
+            now = time.perf_counter()
+            out = json.loads(line)
+            if "error" in out:
+                raise RuntimeError(out["error"])
+            n_toks = len(out.get("token_ids", ()))
+            if last is None:
+                ttfts.append(now - t0)
+            elif n_toks:
+                # One burst may carry several tokens: spread the gap so
+                # the percentile reflects per-TOKEN latency.
+                itls.extend([(now - last) / n_toks] * n_toks)
+            last = now
+            n_total = int(out.get("completion_tokens", n_total))
+        tokens[0] += n_total
 
     def payload(p: str) -> bytes:
         return json.dumps({
@@ -158,11 +190,20 @@ async def run_generate(url: str, clients: int, seconds: float,
             return payload(f"{head}{prompt} #{uid[0]}")
     else:
         body = payload(prompt)
+    path = "/generate_stream" if stream else "/generate"
     total, dt, lats, errors = await _closed_loop(
-        url.rstrip("/") + "/generate", body, clients, seconds,
-        on_response=count_tokens,
+        url.rstrip("/") + path, body, clients, seconds,
+        on_response=consume_stream if stream else count_tokens,
     )
-    return total, dt, lats, errors, tokens[0]
+    stream_stats = {}
+    if stream:
+        for name, samples in (("ttft", ttfts), ("itl", itls)):
+            arr = np.asarray(samples) * 1000.0 if samples else np.zeros(1)
+            for q in (50, 95, 99):
+                stream_stats[f"{name}_p{q}_ms"] = round(
+                    float(np.percentile(arr, q)), 2
+                )
+    return total, dt, lats, errors, tokens[0], stream_stats
 
 
 def report(transport: str, total: int, dt: float, latencies, errors: int,
@@ -206,17 +247,23 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "workload); 0 disables")
     parser.add_argument("--shared-prefix", default="",
                         help="override the shared system prompt text")
+    parser.add_argument("--no-stream", action="store_true",
+                        help="--transport generate: use the unary "
+                             "/generate endpoint instead of streaming "
+                             "/generate_stream (drops TTFT/ITL "
+                             "percentiles from the summary)")
     args = parser.parse_args(argv)
 
     if args.transport == "generate":
-        total, dt, lats, errors, toks = asyncio.run(
+        total, dt, lats, errors, toks, stream_stats = asyncio.run(
             run_generate(args.url, args.clients, args.seconds,
                          args.prompt, args.max_new_tokens,
                          args.temperature, args.shared_prefix_frac,
-                         args.shared_prefix)
+                         args.shared_prefix, stream=not args.no_stream)
         )
         extra = {"completion_tokens": toks,
-                 "tokens_per_s": round(toks / dt, 1) if dt else 0.0}
+                 "tokens_per_s": round(toks / dt, 1) if dt else 0.0,
+                 **stream_stats}
         if args.shared_prefix_frac > 0.0:
             extra["shared_prefix_frac"] = args.shared_prefix_frac
         report("generate", total, dt, lats, errors, args.clients,

@@ -198,6 +198,91 @@ def build_rest_app(
     app.router.add_post("/generate", handle_generate)
     app.router.add_post("/api/v1.0/generate", handle_generate)
 
+    async def handle_generate_stream(request: web.Request):
+        """NDJSON streaming twin of /generate (the REST face of the gRPC
+        GenerateStream servicer): one JSON line per decode-chunk burst,
+        same GenerateResponse schema per line. The response headers are
+        sent with the FIRST chunk, so a streaming client's
+        time-to-first-byte is the engine's real TTFT."""
+        try:
+            msg, _ = await _parse_request(request, pb.GenerateRequest)
+        except Exception as e:
+            return web.json_response(
+                SeldonMicroserviceException(str(e)).to_dict(), status=400
+            )
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        q: asyncio.Queue = asyncio.Queue()
+        done = object()
+
+        def pump():
+            # The user's generate_stream is a sync generator: drain it on
+            # the executor thread, handing each chunk to the event loop.
+            try:
+                try:
+                    for chunk in seldon_methods.generate_stream(
+                        request.app["user_obj"], msg
+                    ):
+                        loop.call_soon_threadsafe(q.put_nowait, chunk)
+                except SeldonNotImplementedError:
+                    # No streaming hook: single-chunk stream around
+                    # generate() (mirrors the gRPC servicer's fallback).
+                    loop.call_soon_threadsafe(
+                        q.put_nowait,
+                        seldon_methods.generate(
+                            request.app["user_obj"], msg
+                        ),
+                    )
+                loop.call_soon_threadsafe(q.put_nowait, done)
+            except Exception as e:
+                logger.exception("generate-stream failed")
+                loop.call_soon_threadsafe(q.put_nowait, e)
+
+        fut = loop.run_in_executor(request.app["executor"], pump)
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/x-ndjson"}
+        )
+        prepared = False
+        try:
+            while True:
+                item = await q.get()
+                if item is done:
+                    break
+                if isinstance(item, Exception):
+                    if not prepared:
+                        return web.json_response(
+                            SeldonMicroserviceException(
+                                str(item), 500
+                            ).to_dict(),
+                            status=500,
+                        )
+                    # Headers already went out 200; the error is an
+                    # in-band trailer line, then the stream ends.
+                    await resp.write(
+                        json.dumps({"error": str(item)}).encode() + b"\n"
+                    )
+                    break
+                if not prepared:
+                    await resp.prepare(request)
+                    prepared = True
+                await resp.write(
+                    json.dumps(
+                        payloads.message_to_dict(item)
+                    ).encode() + b"\n"
+                )
+            if not prepared:
+                await resp.prepare(request)
+            await resp.write_eof()
+        finally:
+            await fut
+        request.app["metrics"].observe(
+            "generate-stream", "rest", time.perf_counter() - t0, None
+        )
+        return resp
+
+    app.router.add_post("/generate_stream", handle_generate_stream)
+    app.router.add_post("/api/v1.0/generate_stream", handle_generate_stream)
+
     async def handle_live(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 

@@ -76,6 +76,65 @@ class EngineConfig:
     prefix_cache: bool = False
     prefix_block: int = 16  # trie granularity; reuse is block-aligned
     prefix_cache_bytes: int = 256 << 20  # HBM budget for retained KV
+    # Stall-free scheduling (opt-in): split admissions into block-aligned
+    # prefill CHUNKS of `prefill_chunk` tokens and pack at most
+    # `dispatch_token_budget` prefill tokens into each scheduler dispatch
+    # alongside the decode chunk, instead of draining the admission queue
+    # first — a long-prompt arrival no longer stalls in-flight streams
+    # for its whole prefill, so tail ITL stays flat under mixed traffic
+    # (Sarathi-style chunked prefill). Chunk k prefills against the KV of
+    # chunks 0..k-1 already resident in the slot cache
+    # (transformer.prefill_with_prefix); the final chunk samples the
+    # first token exactly like the one-shot path, so greedy outputs stay
+    # bit-identical. False keeps the dispatch path byte-identical to the
+    # uninterleaved engine.
+    chunked_prefill: bool = False
+    prefill_chunk: int = 128  # power of two, multiple of prefix_block
+    dispatch_token_budget: int = 0  # prefill tokens per dispatch; 0 -> chunk
+
+    def __post_init__(self):
+        def pow2(n: int) -> bool:
+            return n >= 1 and (n & (n - 1)) == 0
+
+        if self.min_chunk > self.decode_chunk:
+            raise ValueError(
+                f"min_chunk ({self.min_chunk}) must not exceed decode_chunk "
+                f"({self.decode_chunk}) — the adaptive ladder interpolates "
+                f"between them"
+            )
+        if not pow2(self.max_admit):
+            raise ValueError(
+                f"max_admit ({self.max_admit}) must be a power of two — "
+                f"admission groups are padded to pow2 to bound jit variants"
+            )
+        for b in self.prompt_buckets:
+            if not pow2(b):
+                raise ValueError(
+                    f"prompt_buckets entry {b} must be a power of two — "
+                    f"each bucket is a compiled prefill variant"
+                )
+        if self.chunked_prefill:
+            if not pow2(self.prefill_chunk):
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must be a power "
+                    f"of two — each chunk length is a compiled variant"
+                )
+            if self.prefill_chunk % self.prefix_block:
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must be a "
+                    f"multiple of the KV block size prefix_block "
+                    f"({self.prefix_block}) so chunk boundaries never split "
+                    f"a prefix-cache block"
+                )
+            if self.dispatch_token_budget and (
+                self.dispatch_token_budget < self.prefill_chunk
+            ):
+                raise ValueError(
+                    f"dispatch_token_budget ({self.dispatch_token_budget}) "
+                    f"must be 0 (one chunk per dispatch) or >= prefill_chunk "
+                    f"({self.prefill_chunk}) — a dispatch must fit at least "
+                    f"one chunk to make progress"
+                )
 
 
 @dataclasses.dataclass
@@ -99,6 +158,17 @@ class _Request:
     # live slot's prefix can never be evicted.
     prefix_len: Optional[int] = None
     prefix_handle: Any = None
+    # Chunked-prefill state: prompt tokens whose KV is already resident in
+    # the slot cache (prefix-cache hit + dispatched chunks), and whether
+    # the request is still mid-prefill (holds a slot, but decode rosters
+    # must skip it — no tokens exist yet and device `active` is False).
+    prefill_done: int = 0
+    prefilling: bool = False
+    # Observability: when the scheduler first dispatched work for this
+    # request (queue-wait = first_dispatch_at - submitted_at) and when its
+    # latest token burst was emitted (drives the ITL histogram).
+    first_dispatch_at: Optional[float] = None
+    last_burst_at: Optional[float] = None
 
 
 class EngineStats:
@@ -120,9 +190,54 @@ class EngineStats:
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
         self.prefix_evictions = 0
+        # Admission-queue observability: depth sampled at each dispatch,
+        # and submit -> first-dispatch wait per request.
+        self.queue_depth = 0
+        self.queue_wait_sum = 0.0
+        self.queue_wait_count = 0
+        # Inter-token latency histogram (ms, per decode-chunk burst gap).
+        # Fixed edges keep the lock hold O(buckets) and make prometheus
+        # export trivial; quantiles read the bucket upper edge.
+        self.itl_edges_ms = (2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                             500.0, 1000.0)
+        self.itl_counts = [0] * (len(self.itl_edges_ms) + 1)
+        self.itl_sum_ms = 0.0
+        # Chunked-prefill observability: chunks dispatched, prompt tokens
+        # they covered, and how full the per-dispatch token budget ran
+        # (budget_tokens / (budget_dispatches * budget) = utilization).
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.budget_dispatches = 0
+        self.budget_tokens = 0
+        self.budget_limit = 0
+
+    def record_itl_locked(self, ms: float) -> None:
+        """Caller holds self.lock."""
+        i = 0
+        for edge in self.itl_edges_ms:
+            if ms <= edge:
+                break
+            i += 1
+        self.itl_counts[i] += 1
+        self.itl_sum_ms += ms
+
+    def _itl_quantile_locked(self, q: float) -> float:
+        total = sum(self.itl_counts)
+        if not total:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, c in enumerate(self.itl_counts):
+            cum += c
+            if cum >= target:
+                if i < len(self.itl_edges_ms):
+                    return self.itl_edges_ms[i]
+                return 2.0 * self.itl_edges_ms[-1]  # overflow bucket
+        return 2.0 * self.itl_edges_ms[-1]
 
     def snapshot(self) -> Dict[str, float]:
         with self.lock:
+            itl_count = sum(self.itl_counts)
             return {
                 "requests": self.requests,
                 "completed": self.completed,
@@ -137,6 +252,27 @@ class EngineStats:
                 "prefix_hits": self.prefix_hits,
                 "prefix_tokens_saved": self.prefix_tokens_saved,
                 "prefix_evictions": self.prefix_evictions,
+                "queue_depth": self.queue_depth,
+                "mean_queue_wait_ms": (
+                    1000.0 * self.queue_wait_sum / self.queue_wait_count
+                    if self.queue_wait_count
+                    else 0.0
+                ),
+                "itl_count": itl_count,
+                "mean_itl_ms": (
+                    self.itl_sum_ms / itl_count if itl_count else 0.0
+                ),
+                "itl_p50_ms": self._itl_quantile_locked(0.50),
+                "itl_p95_ms": self._itl_quantile_locked(0.95),
+                "itl_p99_ms": self._itl_quantile_locked(0.99),
+                "prefill_chunks": self.prefill_chunks,
+                "prefill_chunk_tokens": self.prefill_chunk_tokens,
+                "budget_utilization": (
+                    self.budget_tokens
+                    / (self.budget_dispatches * self.budget_limit)
+                    if self.budget_dispatches and self.budget_limit
+                    else 0.0
+                ),
             }
 
 
@@ -240,6 +376,33 @@ class InferenceEngine:
                         self._admit_prefix_impl, cfg=self.cfg, mesh=mesh,
                     ),
                     donate_argnums=(1,),
+                )
+        # Chunked prefill (opt-in): chunk lengths are bucketed like
+        # prompts (`_chunk_buckets` = prompt-bucket rungs clamped to the
+        # chunk, so a short final chunk compiles against a snug shape),
+        # and resident-prefix widths reuse the prompt buckets. The chunk
+        # kernel is one jit keyed on (G, Sc) + static prefix_width.
+        self._chunked = bool(self.ecfg.chunked_prefill)
+        self._prefilling: Deque[_Request] = collections.deque()
+        self._jit_admit_chunk = None
+        self._jit_seed_prefix = None
+        if self._chunked:
+            C = min(self.ecfg.prefill_chunk, max(self._buckets))
+            self._prefill_chunk = C
+            self._chunk_buckets = tuple(sorted(
+                {min(b, C) for b in self._buckets} | {C}
+            ))
+            self._jit_admit_chunk = jax.jit(
+                functools.partial(
+                    self._admit_chunk_impl, cfg=self.cfg, mesh=mesh,
+                    return_sub=self._prefix is not None,
+                ),
+                static_argnames=("prefix_width",),
+                donate_argnums=(1,),
+            )
+            if self._prefix is not None:
+                self._jit_seed_prefix = jax.jit(
+                    self._seed_prefix_impl, donate_argnums=(0,)
                 )
         # Chunk-length ladder: exactly the three rungs the policy uses
         # (min / geometric mid / top) — every rung costs a full chunk
@@ -435,6 +598,101 @@ class InferenceEngine:
         return new_state, first, first_done, writes
 
     @staticmethod
+    def _admit_chunk_impl(
+        params, state, toks, plens, starts, seeds, temps, top_ks, top_ps,
+        max_news, slots, finals, *, prefix_width, cfg, mesh=None,
+        return_sub=False,
+    ):
+        """Fused prefill CHUNK: run `toks` [G, Sc] (tokens
+        [start, start+Sc) of each prompt) through prefill_with_prefix
+        against the KV that chunks 0..k-1 (and any prefix-cache hit)
+        already scattered into the slot cache, then scatter the fresh
+        suffix KV back. Rows with finals=True are each prompt's LAST
+        chunk: they sample the first token under the same
+        fold_in(key(seed), plen) key as _admit_impl — co-batched chunk
+        traffic cannot perturb greedy outputs — and arm the slot. Non-
+        final rows only deposit KV; their sampled token is discarded.
+
+        `prefix_width` (static) buckets how much resident KV the chunk
+        attends to: the slice cache[:, slots, :, :W] covers every row's
+        start (start <= W), and prefill_with_prefix's t < start mask
+        hides the tail. pos is set to start+Sc (clamped to plen) even
+        mid-prefill so the decode chunks interleaved between prefill
+        chunks scatter their dead-row garbage write exactly where the
+        NEXT chunk's scatter lands first — never inside KV already
+        written."""
+        G, Sc = toks.shape
+        cache = state["cache"]
+        Smax = cache["k"].shape[3]
+        prefix_kv = {
+            key: cache[key][:, slots, :, :prefix_width] for key in cache
+        }
+        logits, kv = transformer.prefill_with_prefix(
+            params, toks, plens, prefix_kv, starts, cfg
+        )
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(seeds, plens)
+        first = sample_per_row(logits, keys, temps, top_ks, top_ps)
+        first_done = (
+            (first == cfg.eos_token_id)
+            | (max_news <= 1)
+            | (plens + 1 >= Smax)
+        )
+        new_pos = jnp.minimum(plens, starts + Sc)
+        if cfg.kv_cache_dtype == "int8":
+            kq, ks = transformer._quantize_kv(kv["k"])
+            vq, vs = transformer._quantize_kv(kv["v"])
+            writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+        else:
+            dt = cache["k"].dtype
+            writes = {"k": kv["k"].astype(dt), "v": kv["v"].astype(dt)}
+        # Chunk rows land at absolute positions start + i (same advanced-
+        # indexing shape as _admit_prefix_impl's suffix scatter); padding
+        # rows duplicate a real row's slot + data, so duplicate writes
+        # are well-defined.
+        spos = starts[:, None] + jnp.arange(Sc)[None, :]  # [G, Sc]
+        new_cache = {
+            key: cache[key].at[:, slots[:, None], :, spos].set(
+                jnp.moveaxis(writes[key], (1, 3), (0, 1))
+            )
+            for key in cache
+        }
+        new_state = {
+            "cache": new_cache,
+            "last_tok": state["last_tok"].at[slots].set(first),
+            "pos": state["pos"].at[slots].set(new_pos),
+            "active": state["active"].at[slots].set(finals & ~first_done),
+            "temp": state["temp"].at[slots].set(temps),
+            "top_k": state["top_k"].at[slots].set(top_ks),
+            "top_p": state["top_p"].at[slots].set(top_ps),
+            "seeds": state["seeds"].at[slots].set(seeds),
+            "remaining": state["remaining"].at[slots].set(max_news - 1),
+        }
+        first, first_done = InferenceEngine._replicate(
+            mesh, first, first_done
+        )
+        if return_sub:
+            return new_state, first, first_done, writes
+        return new_state, first, first_done
+
+    @staticmethod
+    def _seed_prefix_impl(state, prefix_kv, slot):
+        """Chunked-prefill warm start: scatter a prefix-cache hit's
+        trie-gathered KV [L, Hkv, W, (Dh)] into one slot's cache rows
+        [0, W), so every chunk reads resident KV uniformly whether it
+        came from the trie or from earlier chunks."""
+        cache = state["cache"]
+        W = prefix_kv["k"].shape[2]
+        new_cache = {
+            key: cache[key].at[:, slot, :, :W].set(
+                prefix_kv[key].astype(cache[key].dtype)
+            )
+            for key in cache
+        }
+        return {**state, "cache": new_cache}
+
+    @staticmethod
     def _chunk_impl(params, state, *, cfg, n_steps, mesh=None):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
@@ -571,6 +829,22 @@ class InferenceEngine:
         while g <= self._max_admit:
             sizes.append(g)
             g *= 2
+        if self._chunked:
+            # Chunked engines never run the one-shot admission kernels;
+            # compile the (G x chunk-length x resident-width) chunk
+            # lattice instead, plus the per-width prefix seed scatters.
+            n_chunk_warm = self._warmup_chunked(sizes)
+            for n in self._chunk_sizes:
+                self._state, _, _, _ = self._jit_chunks[n](
+                    self.params, self._state
+                )
+            jax.block_until_ready(self._state["last_tok"])
+            logger.info(
+                "engine warmed: %d prefill-chunk variants + %d decode "
+                "chunk sizes",
+                n_chunk_warm, len(self._chunk_sizes),
+            )
+            return
         admit = self._jit_admit_sub if self._prefix is not None \
             else self._jit_admit
         n_warm = 0
@@ -626,6 +900,46 @@ class InferenceEngine:
             len(self._buckets) * len(sizes), n_warm, len(self._chunk_sizes),
         )
 
+    def _warmup_chunked(self, sizes: List[int]) -> int:
+        """Compile every (group size x chunk length x resident prefix
+        width) chunk variant + the prefix-seed scatters. Widths cover 0
+        (a prompt's first chunk, cold) and each prompt-bucket rung (any
+        later chunk's bucketed start). max_new=1 keeps each call a pure
+        compile: rows finish immediately, no slot state leaks."""
+        Smax = self.ecfg.max_seq_len
+        widths = (0,) + tuple(b for b in self._buckets if b < Smax)
+        n = 0
+        for G in sizes:
+            for Sc in self._chunk_buckets:
+                for W in widths:
+                    starts = jnp.full((G,), W, jnp.int32)
+                    out = self._jit_admit_chunk(
+                        self.params,
+                        self._state,
+                        jnp.zeros((G, Sc), jnp.int32),
+                        jnp.full((G,), W + Sc, jnp.int32),
+                        starts,
+                        jnp.zeros((G,), jnp.uint32),
+                        jnp.ones((G,), jnp.float32),
+                        jnp.zeros((G,), jnp.int32),
+                        jnp.ones((G,), jnp.float32),
+                        jnp.ones((G,), jnp.int32),
+                        jnp.arange(G, dtype=jnp.int32),
+                        jnp.ones((G,), jnp.bool_),
+                        prefix_width=W,
+                    )
+                    self._state = out[0]
+                    n += 1
+        if self._jit_seed_prefix is not None:
+            for W in widths[1:]:
+                pkv_full = transformer.init_cache(self.cfg, 1, W)
+                pkv = {key: pkv_full[key][:, 0] for key in pkv_full}
+                self._state = self._jit_seed_prefix(
+                    self._state, pkv, jnp.int32(0)
+                )
+                n += 1
+        return n
+
     # --- scheduler loop -----------------------------------------------------
 
     def _bucket(self, n: int) -> int:
@@ -660,15 +974,36 @@ class InferenceEngine:
             )
         return self._bucket(len(req.tokens)), 0
 
-    def _dispatch_admits(self) -> List[Tuple[List[_Request], Any, Any]]:
-        """Admit FIFO prefix runs of same-bucket waiting requests as batched
-        groups. Dispatches device work only — returns un-synced handles."""
+    def _drain_pending(self) -> None:
         while True:
             try:
                 self._waiting.append(self._pending.get_nowait())
             except queue.Empty:
                 break
-        admits: List[Tuple[List[_Request], Any, Any]] = []
+        with self.stats.lock:
+            self.stats.queue_depth = len(self._waiting)
+
+    def _record_first_dispatch(self, group: List[_Request]) -> None:
+        """Queue-wait accounting: submit -> first dispatch, once per
+        request (chunked prefills dispatch the same request many times)."""
+        now = time.perf_counter()
+        wait = 0.0
+        n = 0
+        for req in group:
+            if req.first_dispatch_at is None:
+                req.first_dispatch_at = now
+                wait += now - req.submitted_at
+                n += 1
+        if n:
+            with self.stats.lock:
+                self.stats.queue_wait_sum += wait
+                self.stats.queue_wait_count += n
+
+    def _dispatch_admits(self) -> List[Tuple[List[_Request], Any, Any, Any]]:
+        """Admit FIFO prefix runs of same-bucket waiting requests as batched
+        groups. Dispatches device work only — returns un-synced handles."""
+        self._drain_pending()
+        admits: List[Tuple[List[_Request], Any, Any, Any]] = []
         while self._free and self._waiting:
             key = self._admit_key(self._waiting[0])
             max_g = min(self._max_admit, len(self._free))
@@ -697,7 +1032,7 @@ class InferenceEngine:
 
     def _dispatch_admit_group(
         self, group: List[_Request], Sb: int, Pb: int = 0
-    ) -> Tuple[List[_Request], Any, Any]:
+    ) -> Tuple[List[_Request], Any, Any, Any]:
         """Build host arrays for `group`, dispatch the fused admission.
 
         G is padded up to a power of two by replicating the last request
@@ -712,6 +1047,7 @@ class InferenceEngine:
         Gp = 1
         while Gp < G:
             Gp *= 2
+        self._record_first_dispatch(group)
         for req in group:
             req.slot = self._free.pop()
             req.expected = 1  # the admission samples the first token
@@ -789,7 +1125,9 @@ class InferenceEngine:
             self._slots[req.slot] = req
         if self._prefix is not None:
             self._insert_prompt_kv(group, writes, warm=bool(Pb))
-        return group, first, first_done
+        # finals=None marks "every row is an armed admission" — the
+        # non-chunked twin of the chunked path's per-row finals list.
+        return group, None, first, first_done
 
     def _insert_prompt_kv(self, group: List[_Request], writes: Dict[str, Any],
                           warm: bool) -> None:
@@ -817,20 +1155,253 @@ class InferenceEngine:
                 with self.stats.lock:
                     self.stats.prefix_evictions += evicted
 
+    # --- chunked-prefill scheduling ----------------------------------------
+
+    def _chunk_bucket(self, n: int) -> int:
+        for b in self._chunk_buckets:
+            if n <= b:
+                return b
+        return self._chunk_buckets[-1]
+
+    def _admit_chunk_slot(self, req: _Request) -> None:
+        """Admit a request into a slot for chunked prefill: register it
+        immediately (error paths then fail it through _slots), look up
+        the prefix cache, and seed any warm hit's trie KV into the slot
+        so chunk 0 starts at the first uncached block."""
+        self._record_first_dispatch([req])
+        req.slot = self._free.pop()
+        req.prefilling = True
+        self._slots[req.slot] = req
+        if self._prefix is not None:
+            self._admit_key(req)  # trie lookup + pin; sets prefix_len
+            if req.prefix_len:
+                W = self._bucket(req.prefix_len)
+                pkv = self._prefix.gather(req.prefix_handle, W)
+                self._state = self._jit_seed_prefix(
+                    self._state, pkv, jnp.int32(req.slot)
+                )
+                req.prefill_done = req.prefix_len
+
+    def _collect_chunk_work(
+        self, left: int
+    ) -> List[Tuple[_Request, int, int, bool, int]]:
+        """One budget pass: pop each dispatchable request at most once
+        and size its next chunk. Continuing prefills go first (finish
+        in-flight prompts before admitting new ones, round-robin via
+        the deque); new admissions need a free slot and are gated on a
+        cold-size estimate BEFORE the slot pop / trie lookup, so a
+        request never ends up half-admitted outside the dispatch.
+        Returns (req, Sc, prefix_width, final, chunk_len) rows."""
+        C = self._prefill_chunk
+        work: List[Tuple[_Request, int, int, bool, int]] = []
+        while left > 0:
+            if self._prefilling:
+                req = self._prefilling.popleft()
+                if req.finished:  # failed by an earlier error path
+                    continue
+            elif self._waiting and self._free:
+                req = self._waiting[0]
+                rem = len(req.tokens)
+                est = C if rem > C else self._chunk_bucket(rem)
+                if est > left:
+                    break
+                self._waiting.popleft()
+                self._admit_chunk_slot(req)
+            else:
+                break
+            start = req.prefill_done
+            rem = len(req.tokens) - start
+            final = rem <= C
+            Sc = self._chunk_bucket(rem) if final else C
+            if Sc > left:
+                # Keeps FIFO priority for the next dispatch's budget.
+                self._prefilling.appendleft(req)
+                break
+            clen = rem if final else C
+            W = 0 if start == 0 else self._bucket(start)
+            work.append((req, Sc, W, final, clen))
+            left -= Sc
+        return work
+
+    def _dispatch_chunk_group(
+        self, rows: List[Tuple[_Request, int, int, bool, int]]
+    ) -> Tuple[List[_Request], Any, Any, Any]:
+        """Build host arrays for one same-(Sc, W) run of chunk rows and
+        dispatch the fused chunk kernel. G pads to a power of two by
+        replicating the last row (identical slot + data — duplicate
+        scatters are well-defined), mirroring _dispatch_admit_group."""
+        group = [r[0] for r in rows]
+        Sc, W = rows[0][1], rows[0][2]
+        G = len(rows)
+        Gp = 1
+        while Gp < G:
+            Gp *= 2
+        toks = np.full((Gp, Sc), self.cfg.pad_token_id, np.int32)
+        plens = np.empty((Gp,), np.int32)
+        starts = np.empty((Gp,), np.int32)
+        seeds = np.empty((Gp,), np.uint32)
+        temps = np.empty((Gp,), np.float32)
+        top_ks = np.empty((Gp,), np.int32)
+        top_ps = np.empty((Gp,), np.float32)
+        max_news = np.empty((Gp,), np.int32)
+        slots = np.empty((Gp,), np.int32)
+        finals = np.zeros((Gp,), bool)
+        for i in range(Gp):
+            req, _, _, final, clen = rows[min(i, G - 1)]
+            sp = req.params
+            start = req.prefill_done
+            toks[i, :clen] = req.tokens[start:start + clen]
+            plens[i] = len(req.tokens)
+            starts[i] = start
+            seeds[i] = np.uint32(int(sp.seed) & 0xFFFFFFFF)
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+            max_news[i] = sp.max_new_tokens
+            slots[i] = req.slot
+            finals[i] = final
+        out = self._jit_admit_chunk(
+            self.params,
+            self._state,
+            jnp.asarray(toks),
+            jnp.asarray(plens),
+            jnp.asarray(starts),
+            jnp.asarray(seeds),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            jnp.asarray(max_news),
+            jnp.asarray(slots),
+            jnp.asarray(finals),
+            prefix_width=W,
+        )
+        if self._prefix is not None:
+            self._state, first, first_done, writes = out
+        else:
+            self._state, first, first_done = out
+            writes = None
+        finals_l = []
+        for req, _, _, final, clen in rows:
+            req.prefill_done += clen
+            finals_l.append(final)
+            if final:
+                req.prefilling = False
+                req.expected = 1  # the final chunk samples the first token
+            else:
+                self._prefilling.append(req)
+        if writes is not None:
+            self._insert_chunk_kv(rows, writes)
+        return group, finals_l, first, first_done
+
+    def _insert_chunk_kv(
+        self,
+        rows: List[Tuple[_Request, int, int, bool, int]],
+        writes: Dict[str, Any],
+    ) -> None:
+        """Extend the trie with each chunk's freshly-written KV blocks.
+        Blocks below the chunk's start already live in the trie (warm
+        prefix + earlier chunks, pinned by the request's handle — chunk
+        starts are block-aligned by the prefill_chunk % prefix_block
+        validation), so get_span only ever covers [start, end)."""
+        for i, (req, _, _, _, clen) in enumerate(rows):
+            end = req.prefill_done  # already advanced past this chunk
+            start = end - clen
+
+            def get_span(s, e, i=i, start=start):
+                return {
+                    key: writes[key][:, i, :, s - start:e - start]
+                    for key in writes
+                }
+
+            evicted = self._prefix.insert(
+                req.tokens[:end], get_span, handle=req.prefix_handle
+            )
+            if evicted:
+                with self.stats.lock:
+                    self.stats.prefix_evictions += evicted
+
+    def _dispatch_prefill_chunks(
+        self,
+    ) -> List[Tuple[List[_Request], Any, Any, Any]]:
+        """Chunked-prefill admission: pack at most dispatch_token_budget
+        prefill tokens into THIS dispatch, then hand back to the decode
+        chunk — instead of draining the whole queue. A request's chunks
+        are sequential jit calls (chunk k+1 reads chunk k's KV from the
+        slot cache), so each budget pass dispatches one chunk per
+        request; repeated passes let a lone long prompt still use the
+        full budget."""
+        self._drain_pending()
+        admits: List[Tuple[List[_Request], Any, Any, Any]] = []
+        budget = self.ecfg.dispatch_token_budget or self._prefill_chunk
+        left = budget
+        n_chunks = 0
+        n_tokens = 0
+        while left > 0:
+            work = self._collect_chunk_work(left)
+            if not work:
+                break
+            i = 0
+            while i < len(work):
+                j = i + 1
+                while (
+                    j < len(work)
+                    and j - i < self._max_admit
+                    and work[j][1:3] == work[i][1:3]
+                ):
+                    j += 1
+                rows = work[i:j]
+                try:
+                    admits.append(self._dispatch_chunk_group(rows))
+                    for _, Sc, _, _, clen in rows:
+                        left -= Sc
+                        n_chunks += 1
+                        n_tokens += clen
+                except Exception as e:  # bad batch must not kill the loop
+                    logger.exception(
+                        "chunk dispatch failed for requests %s",
+                        [r[0].rid for r in rows],
+                    )
+                    for req, *_ in rows:
+                        req.out.put({"error": str(e)})
+                        self._complete(req)
+                i = j
+        if n_chunks:
+            with self.stats.lock:
+                self.stats.prefill_chunks += n_chunks
+                self.stats.prefill_chunk_tokens += n_tokens
+                self.stats.budget_dispatches += 1
+                self.stats.budget_tokens += budget - left
+                self.stats.budget_limit = budget
+        return admits
+
+    # --- boundary processing -----------------------------------------------
+
     def _process_admits(
         self,
-        admits: List[Tuple[List[_Request], Any, Any]],
+        admits: List[Tuple[List[_Request], Any, Any, Any]],
         admit_data: List[Tuple[np.ndarray, np.ndarray]],
     ) -> None:
-        for (group, _, _), (first_h, done_h) in zip(admits, admit_data):
+        for (group, finals, _, _), (first_h, done_h) in zip(
+            admits, admit_data
+        ):
             now = time.perf_counter()
             ttft_total = 0.0
+            # finals=None: one-shot admission, every row armed. A chunked
+            # group's non-final rows deposited KV only — no token exists
+            # for them yet, so they are skipped wholesale here.
+            n_armed = (
+                len(group) if finals is None
+                else sum(1 for f in finals if f)
+            )
             for i, req in enumerate(group):
+                if finals is not None and not finals[i]:
+                    continue
                 if req.finished:  # already failed by an error path
                     continue
                 slot = req.slot
                 first_tok = int(first_h[i])
                 req.first_token_at = now
+                req.last_burst_at = now
                 ttft_ms = 1000.0 * (now - req.submitted_at)
                 ttft_total += ttft_ms
                 req.n_generated = 1
@@ -843,8 +1414,8 @@ class InferenceEngine:
                     self._active_host[slot] = True
             with self.stats.lock:
                 self.stats.ttft_sum += ttft_total / 1000.0
-                self.stats.ttft_count += len(group)
-                self.stats.tokens_out += len(group)
+                self.stats.ttft_count += n_armed
+                self.stats.tokens_out += n_armed
 
     def _process_chunk(self, toks_h, valid_h, active_h, roster) -> None:
         """toks_h [K, B], valid_h [K, B], active_h [B] — host arrays;
@@ -856,6 +1427,8 @@ class InferenceEngine:
         emitted tokens."""
         n_valid = valid_h.sum(axis=0)
         total = 0
+        now = time.perf_counter()
+        gaps_ms: List[float] = []
         for slot, req in enumerate(roster):
             if req is None or req.finished:
                 continue
@@ -864,11 +1437,18 @@ class InferenceEngine:
                 req.out.put({"tokens": toks_h[:n, slot].tolist()})
                 req.n_generated += n
                 total += n
+                if req.last_burst_at is not None:
+                    # Burst-gap ITL: one sample per boundary burst — the
+                    # client-visible stall a prefill interloper causes.
+                    gaps_ms.append(1000.0 * (now - req.last_burst_at))
+                req.last_burst_at = now
             if not active_h[slot]:
                 self._complete(req)
-        if total:
+        if total or gaps_ms:
             with self.stats.lock:
                 self.stats.tokens_out += total
+                for g in gaps_ms:
+                    self.stats.record_itl_locked(g)
 
     def _complete(self, req: _Request) -> None:
         """Finish a request (idempotent) and free its slot unless the
@@ -903,7 +1483,7 @@ class InferenceEngine:
             if pending is None:
                 continue
             admits, _, roster = pending
-            for group, _, _ in admits:
+            for group, _, _, _ in admits:
                 for req in group:
                     live[req.rid] = req
             for req in roster or []:
@@ -917,6 +1497,7 @@ class InferenceEngine:
         self._slots = [None] * B
         self._free = list(range(B))
         self._active_host[:] = False
+        self._prefilling.clear()  # mid-prefill requests failed via _slots
         self._state = self._fresh_state()
 
     def _process_boundary(self, admits, chunk_handles, roster) -> None:
@@ -924,13 +1505,26 @@ class InferenceEngine:
         run host bookkeeping."""
         admit_data, chunk_data = jax.device_get(
             (
-                [(f, d) for _, f, d in admits],
+                [(f, d) for _, _, f, d in admits],
                 chunk_handles,
             )
         )
         self._process_admits(admits, admit_data)
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
+
+    def _roster(self) -> List[Optional[_Request]]:
+        """Slot -> request snapshot for THIS wave's decode chunk. Mid-
+        prefill requests hold slots but have produced no tokens and are
+        device-inactive — masking them out keeps _process_chunk from
+        reading their columns (and completing them on active=False) and
+        keeps _recycle_budget_spent from charging them decode budget.
+        Without chunked prefill no slot is ever mid-prefill, so this is
+        exactly list(self._slots)."""
+        return [
+            None if (r is not None and r.prefilling) else r
+            for r in self._slots
+        ]
 
     def _pick_chunk(self) -> int:
         """Prefill-priority chunk policy: admissions only happen at chunk
@@ -1010,7 +1604,7 @@ class InferenceEngine:
             admits, chunk_handles, roster = item
             try:
                 admit_data, chunk_data = jax.device_get(
-                    ([(f, d) for _, f, d in admits], chunk_handles)
+                    ([(f, d) for _, _, f, d in admits], chunk_handles)
                 )
                 with self._book:
                     self._process_admits(admits, admit_data)
@@ -1046,10 +1640,13 @@ class InferenceEngine:
         exception, self._dispatch_wreck holds the partial boundary so
         the error path can fail recycled-out-of-_slots requests."""
         self._dispatch_wreck = None
-        admits = self._dispatch_admits()
+        admits = (
+            self._dispatch_prefill_chunks() if self._chunked
+            else self._dispatch_admits()
+        )
         self._dispatch_wreck = (admits, None, None)
         if admits or self._active_host.any():
-            roster = list(self._slots)
+            roster = self._roster()
             self._dispatch_wreck = (admits, None, roster)
             n = self._pick_chunk()
             self._state, toks, valid, active_after = self._jit_chunks[n](
@@ -1064,7 +1661,7 @@ class InferenceEngine:
             # each other instead of serializing one round trip each
             # (the fetcher was the pipeline bottleneck at small decode
             # chunks, where a chunk computes faster than one round trip).
-            for _, f, d in admits:
+            for _, _, f, d in admits:
                 f.copy_to_host_async()
                 d.copy_to_host_async()
             for h in (toks, valid, active_after):
@@ -1102,12 +1699,15 @@ class InferenceEngine:
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path
             try:
-                admits = self._dispatch_admits()
+                admits = (
+                    self._dispatch_prefill_chunks() if self._chunked
+                    else self._dispatch_admits()
+                )
                 if admits or self._active_host.any():
                     # Chunk consumes the post-admission state; device-side
                     # `active` is already armed even though _active_host
                     # lags until _process_admits.
-                    roster = list(self._slots)
+                    roster = self._roster()
                     n = self._pick_chunk()
                     self._state, toks, valid, active_after = (
                         self._jit_chunks[n](self.params, self._state)

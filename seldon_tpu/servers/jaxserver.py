@@ -53,6 +53,9 @@ class JAXServer(SeldonComponent):
         mesh_sp: int = 0,
         prefix_cache: int = -1,
         prefix_cache_mb: int = 0,
+        chunked_prefill: int = -1,
+        prefill_chunk: int = 0,
+        dispatch_token_budget: int = 0,
     ):
         self.model_uri = model_uri
         self.preset = preset
@@ -85,6 +88,21 @@ class JAXServer(SeldonComponent):
         self.prefix_cache = bool(int(prefix_cache))
         self.prefix_cache_mb = int(
             prefix_cache_mb or _os.environ.get("PREFIX_CACHE_MB", "0") or 0
+        )
+        # Stall-free chunked prefill (servers/engine.py): unit parameter,
+        # or CHUNKED_PREFILL=1 / PREFILL_CHUNK / DISPATCH_TOKEN_BUDGET
+        # env. -1 / 0 = follow the env (default off).
+        if int(chunked_prefill) < 0:
+            chunked_prefill = int(
+                _os.environ.get("CHUNKED_PREFILL", "0") or 0
+            )
+        self.chunked_prefill = bool(int(chunked_prefill))
+        self.prefill_chunk = int(
+            prefill_chunk or _os.environ.get("PREFILL_CHUNK", "0") or 0
+        )
+        self.dispatch_token_budget = int(
+            dispatch_token_budget
+            or _os.environ.get("DISPATCH_TOKEN_BUDGET", "0") or 0
         )
         self._loaded = False
         self._load_lock = threading.Lock()
@@ -198,6 +216,12 @@ class JAXServer(SeldonComponent):
                 ekw["prefix_cache"] = True
                 if self.prefix_cache_mb:
                     ekw["prefix_cache_bytes"] = self.prefix_cache_mb << 20
+            if self.chunked_prefill:
+                ekw["chunked_prefill"] = True
+                if self.prefill_chunk:
+                    ekw["prefill_chunk"] = self.prefill_chunk
+                if self.dispatch_token_budget:
+                    ekw["dispatch_token_budget"] = self.dispatch_token_budget
             self.engine = InferenceEngine(
                 params,
                 cfg,
@@ -411,6 +435,22 @@ class JAXServer(SeldonComponent):
              "value": float(s["prefix_tokens_saved"])},
             {"type": "GAUGE", "key": "jaxserver_prefix_evictions",
              "value": float(s["prefix_evictions"])},
+            {"type": "GAUGE", "key": "jaxserver_queue_depth",
+             "value": float(s["queue_depth"])},
+            {"type": "GAUGE", "key": "jaxserver_mean_queue_wait_ms",
+             "value": s["mean_queue_wait_ms"]},
+            {"type": "GAUGE", "key": "jaxserver_itl_p50_ms",
+             "value": s["itl_p50_ms"]},
+            {"type": "GAUGE", "key": "jaxserver_itl_p95_ms",
+             "value": s["itl_p95_ms"]},
+            {"type": "GAUGE", "key": "jaxserver_itl_p99_ms",
+             "value": s["itl_p99_ms"]},
+            {"type": "GAUGE", "key": "jaxserver_prefill_chunks",
+             "value": float(s["prefill_chunks"])},
+            {"type": "GAUGE", "key": "jaxserver_prefill_chunk_tokens",
+             "value": float(s["prefill_chunk_tokens"])},
+            {"type": "GAUGE", "key": "jaxserver_budget_utilization",
+             "value": s["budget_utilization"]},
         ]
 
     def tags(self) -> Dict:

@@ -180,6 +180,11 @@ def test_loadtester_generate_against_live_server(server, capsys):
     assert d["requests"] >= 1
     assert d["requests"] <= d["completion_tokens"] <= 4 * d["requests"]
     assert d["tokens_per_s"] > 0
+    # Default transport is now the NDJSON stream: per-stream TTFT/ITL
+    # percentiles ride along in the summary.
+    for q in (50, 95, 99):
+        assert d[f"ttft_p{q}_ms"] > 0
+        assert d[f"itl_p{q}_ms"] >= 0
 
 
 def test_jaxserver_predict_scores(server):

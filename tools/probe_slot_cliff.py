@@ -40,6 +40,7 @@ def probe(params, cfg, slots: int) -> None:
         prompt_buckets=(PROMPT,),
         max_admit=8,
         decode_chunk=1,  # single steps: isolate per-step cost
+        min_chunk=1,  # keep the single-step rung valid (min <= decode)
     )
     eng = InferenceEngine(params, cfg, ecfg)
     eng.warmup()
