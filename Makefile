@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 bench bench-orchestrator native native-tsan ci
+.PHONY: proto test test-e2e tier1 bench bench-orchestrator native native-tsan ci fuzz-alloc
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -26,6 +26,12 @@ tier1:
 	rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
+
+# Long-haul randomized sweep of the paged-KV block allocator. The fast
+# tier runs the same test at FUZZ_EXAMPLES=300 (the pytest default).
+fuzz-alloc:
+	env JAX_PLATFORMS=cpu FUZZ_EXAMPLES=20000 \
+	  python -m pytest tests/test_paged_kv.py -q -m fuzz
 
 bench:
 	python bench.py

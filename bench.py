@@ -83,6 +83,15 @@ PREFIX_NREQ = int(os.environ.get("BENCH_PREFIX_NREQ", "24"))
 CHUNKED = os.environ.get("BENCH_CHUNKED", "0") == "1"
 CHUNKED_STREAMS = int(os.environ.get("BENCH_CHUNKED_STREAMS", "6"))
 CHUNKED_LONG_X = int(os.environ.get("BENCH_CHUNKED_LONG_X", "8"))
+# Paged-KV phase (opt-in): concurrent short-decode streams at a FIXED KV
+# HBM budget, dense slab vs paged pool. The dense engine reserves
+# max_seq_len per slot, so short streams waste the window's tail; the
+# paged engine carves the same token budget into kv_block blocks and
+# admits until the POOL (not the slot count) runs out. Also records
+# zero-copy warm admissions off the block trie. Recorded in detail.paged.
+PAGED = os.environ.get("BENCH_PAGED", "0") == "1"
+PAGED_DENSE_SLOTS = int(os.environ.get("BENCH_PAGED_DENSE_SLOTS", "4"))
+PAGED_KV_BLOCK = int(os.environ.get("BENCH_PAGED_KV_BLOCK", "16"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
 
 
@@ -224,6 +233,8 @@ def _phase_score(line: dict | None) -> int:
     if "prefix" in d:
         s += 1
     if "chunked" in d:
+        s += 1
+    if "paged" in d:
         s += 1
     if not d.get("partial"):
         s += 10
@@ -775,6 +786,122 @@ def _measure_chunked(params, cfg) -> dict:
     }
 
 
+def _measure_paged(params, cfg) -> dict:
+    """Fixed-KV-HBM concurrency phase: how many short-decode streams run
+    at once on the SAME KV budget, dense slab vs paged pool.
+
+    The dense engine reserves max_seq_len tokens per slot the moment a
+    request is admitted, so its concurrency is slot-capped even when
+    every stream writes a fraction of the window. The paged engine gets
+    a pool holding exactly the dense slab's tokens (dense_slots x
+    max_seq_len), carved into kv_block blocks, and 4x the slot count:
+    admission stops at POOL exhaustion, not slot exhaustion, so short
+    streams pack ~window/stream_tokens times denser. A warm leg on the
+    paged engine then readmits one shared prompt and records zero-copy
+    admissions (block refcounts, no KV copies) off the block trie."""
+    import threading
+
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    bs = PAGED_KV_BLOCK
+    prompt_len = 2 * bs  # 2 blocks: warm readmission shares block 1 in full
+    new_toks = min(NEW_TOKENS, 16)
+    blocks_per_stream = -(-(prompt_len + new_toks + 1) // bs)
+    # Window = 4x a short stream's footprint: the dense slab reserves it
+    # whole per slot; the paged pool only hands out what streams write.
+    smax = 4 * blocks_per_stream * bs
+    pool_blocks = PAGED_DENSE_SLOTS * (smax // bs)  # dense slab's budget
+    n_streams = min(4 * PAGED_DENSE_SLOTS, pool_blocks // blocks_per_stream)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(3, cfg.vocab_size, size=(prompt_len,)).tolist()
+               for _ in range(n_streams)]
+
+    def run(paged: bool):
+        pkw = dict(paged_kv=True, kv_block=bs,
+                   kv_pool_blocks=pool_blocks + 1,  # +1: reserved trash
+                   prefix_cache=True, prefix_block=bs) if paged else {}
+        ecfg = EngineConfig(
+            max_slots=4 * PAGED_DENSE_SLOTS if paged else PAGED_DENSE_SLOTS,
+            max_seq_len=smax,
+            prompt_buckets=(prompt_len,),
+            max_admit=4,
+            decode_chunk=4,
+            **pkw,
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+        engine.warmup()
+        engine.start()
+        peak = [0]
+        done = threading.Event()
+
+        def watch():  # occupancy gauge: live (unfinished) slots
+            while not done.is_set():
+                n = sum(1 for r in engine._slots
+                        if r is not None and not r.finished)
+                peak[0] = max(peak[0], n)
+                time.sleep(0.001)
+
+        w = threading.Thread(target=watch, daemon=True)
+        w.start()
+        t0 = time.perf_counter()
+        qs = [engine.submit(p, SamplingParams(temperature=0.0,
+                                              max_new_tokens=new_toks,
+                                              seed=i))
+              for i, p in enumerate(prompts)]
+        for q in qs:
+            while q.get(timeout=300) is not None:
+                pass
+        makespan = time.perf_counter() - t0
+        done.set()
+        w.join(timeout=5)
+        return engine, peak[0], makespan
+
+    dense_eng, dense_peak, dense_s = run(paged=False)
+    dense_eng.stop()
+    paged_eng, paged_peak, paged_s = run(paged=True)
+
+    # Warm leg: seed one shared prompt into the block trie, then readmit
+    # it — each warm admission refcounts the retained full blocks
+    # instead of copying KV (the dense prefix cache's seed-copy path).
+    shared = prompts[0]
+
+    def drain(q):
+        while q.get(timeout=300) is not None:
+            pass
+
+    drain(paged_eng.submit(shared, SamplingParams(temperature=0.0,
+                                                  max_new_tokens=new_toks)))
+    s0 = paged_eng.stats.snapshot()
+    for i in range(4):
+        drain(paged_eng.submit(shared, SamplingParams(
+            temperature=0.0, max_new_tokens=new_toks, seed=100 + i)))
+    s1 = paged_eng.stats.snapshot()
+    paged_eng.stop()
+    return {
+        "kv_block": bs,
+        "kv_pool_blocks": pool_blocks + 1,
+        "dense_slots": PAGED_DENSE_SLOTS,
+        "paged_slots": 4 * PAGED_DENSE_SLOTS,
+        "window_tokens": smax,
+        "stream_tokens": prompt_len + new_toks,
+        "n_streams": n_streams,
+        "dense_peak_concurrency": dense_peak,
+        "paged_peak_concurrency": paged_peak,
+        "concurrency_x": (round(paged_peak / dense_peak, 2)
+                          if dense_peak else None),
+        "dense_makespan_s": round(dense_s, 3),
+        "paged_makespan_s": round(paged_s, 3),
+        "zero_copy_admissions": int(s1["zero_copy_admissions"]
+                                    - s0["zero_copy_admissions"]),
+        "cow_copies": int(s1["cow_copies"] - s0["cow_copies"]),
+        "prefix_seed_copies": int(s1["prefix_seed_copies"]),
+        "pool_stalls": int(s1["pool_stalls"]),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -827,6 +954,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"chunked phase failed: {e!r}")
             detail["chunked_error"] = str(e)
+
+    if PAGED:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["paged"] = _measure_paged(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"paged phase failed: {e!r}")
+            detail["paged_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

@@ -115,13 +115,38 @@ async def run_grpc(target: str, payload_rows, clients: int, seconds: float):
     return sum(counts), dt, latencies, errors[0]
 
 
+def parse_decode_len_dist(spec: str) -> Optional[tuple]:
+    """Parse --decode-len-dist. Supported: "uniform:a,b" — each request
+    draws max_new_tokens uniformly from [a, b]. Empty spec -> None
+    (every request uses the fixed --max-new-tokens)."""
+    if not spec:
+        return None
+    kind, _, rest = spec.partition(":")
+    if kind != "uniform":
+        raise ValueError(
+            f"unknown decode-len-dist {spec!r} (supported: uniform:a,b)"
+        )
+    try:
+        a, b = (int(x) for x in rest.split(","))
+    except Exception:
+        raise ValueError(
+            f"decode-len-dist {spec!r} needs two ints: uniform:a,b"
+        )
+    if not 1 <= a <= b:
+        raise ValueError(
+            f"decode-len-dist bounds must satisfy 1 <= a <= b, got {spec!r}"
+        )
+    return (a, b)
+
+
 async def run_generate(url: str, clients: int, seconds: float,
                        prompt: str = "benchmark prompt",
                        max_new_tokens: int = 32,
                        temperature: float = 0.0,
                        shared_prefix_frac: float = 0.0,
                        shared_prefix: str = "",
-                       stream: bool = True):
+                       stream: bool = True,
+                       decode_len_dist: str = ""):
     """LLM serving load: closed-loop generation clients. Latency is full
     completion time; tokens/s is the serving-throughput number. Greedy
     by default so completion lengths — and therefore tokens/s — are
@@ -138,7 +163,14 @@ async def run_generate(url: str, clients: int, seconds: float,
     fraction of requests opens with one common system prompt (the rest
     get per-request cold prefixes), so an engine with
     EngineConfig.prefix_cache serves them off retained KV — watch
-    jaxserver_prefix_hits / prefix_tokens_saved move."""
+    jaxserver_prefix_hits / prefix_tokens_saved move.
+
+    decode_len_dist (e.g. "uniform:8,256") draws a fresh max_new_tokens
+    per request — the short/long decode mix that exposes paged-KV pool
+    churn and fragmentation (a fixed length never stresses the
+    allocator's reuse path)."""
+    dist = parse_decode_len_dist(decode_len_dist)
+    len_rng = np.random.default_rng(1)
     tokens = [0]
     ttfts: List[float] = []
     itls: List[float] = []
@@ -169,8 +201,11 @@ async def run_generate(url: str, clients: int, seconds: float,
         tokens[0] += n_total
 
     def payload(p: str) -> bytes:
+        mnt = max_new_tokens if dist is None else int(
+            len_rng.integers(dist[0], dist[1] + 1)
+        )
         return json.dumps({
-            "prompt": p, "max_new_tokens": max_new_tokens,
+            "prompt": p, "max_new_tokens": mnt,
             "temperature": temperature,
         }).encode()
 
@@ -188,6 +223,9 @@ async def run_generate(url: str, clients: int, seconds: float,
             head = (pre if rng.random() < shared_prefix_frac
                     else f"cold prefix {uid[0]:08d}. ")
             return payload(f"{head}{prompt} #{uid[0]}")
+    elif dist is not None:
+        def body() -> bytes:  # fresh per-request decode length
+            return payload(prompt)
     else:
         body = payload(prompt)
     path = "/generate_stream" if stream else "/generate"
@@ -247,6 +285,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "workload); 0 disables")
     parser.add_argument("--shared-prefix", default="",
                         help="override the shared system prompt text")
+    parser.add_argument("--decode-len-dist", default="",
+                        help="--transport generate: per-request "
+                             "max_new_tokens distribution, e.g. "
+                             "uniform:8,256 (short/long decode mix — the "
+                             "workload that exposes paged-KV pool churn); "
+                             "empty uses --max-new-tokens for every "
+                             "request")
     parser.add_argument("--no-stream", action="store_true",
                         help="--transport generate: use the unary "
                              "/generate endpoint instead of streaming "
@@ -259,13 +304,16 @@ def main(argv: Optional[List[str]] = None) -> None:
             run_generate(args.url, args.clients, args.seconds,
                          args.prompt, args.max_new_tokens,
                          args.temperature, args.shared_prefix_frac,
-                         args.shared_prefix, stream=not args.no_stream)
+                         args.shared_prefix, stream=not args.no_stream,
+                         decode_len_dist=args.decode_len_dist)
         )
         extra = {"completion_tokens": toks,
                  "tokens_per_s": round(toks / dt, 1) if dt else 0.0,
                  **stream_stats}
         if args.shared_prefix_frac > 0.0:
             extra["shared_prefix_frac"] = args.shared_prefix_frac
+        if args.decode_len_dist:
+            extra["decode_len_dist"] = args.decode_len_dist
         report("generate", total, dt, lats, errors, args.clients,
                extra=extra)
         return

@@ -663,6 +663,182 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> Cache:
     return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
 
 
+def init_paged_cache(cfg: ModelConfig, num_blocks: int, block: int) -> Cache:
+    """Paged KV pool: HEAD-major [L, NB, Hkv, block, Dh] (scales
+    [L, NB, Hkv, block]) — the dense slab's [B, T] plane cut into NB
+    fixed-size blocks of `block` tokens, addressed through per-slot
+    int32 block tables instead of a contiguous slice. Layout inside a
+    block is identical to the slab, so a gather through the table
+    reproduces the dense cache bit-for-bit (paged_gather_kv) and the
+    attention math is shared with the dense path."""
+    shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block, cfg.head_dim)
+    if cfg.kv_cache_dtype == "int8":
+        sshape = shape[:-1]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            # Same min-clamp as init_cache: unwritten slots dequantize to
+            # exact zeros, keeping garbage finite (the hard t < pos mask
+            # zeroes its weight either way).
+            "k_scale": jnp.full(sshape, 1e-8, jnp.bfloat16),
+            "v_scale": jnp.full(sshape, 1e-8, jnp.bfloat16),
+        }
+    dt = _dtype(cfg)
+    return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
+
+
+def paged_gather_kv(pool_layer: Cache, table: jnp.ndarray) -> Cache:
+    """Gather ONE layer's K/V dense view through block tables.
+
+    pool_layer: {"k","v"[,scales]} [NB, Hkv, block, (Dh)];
+    table: [B, T // block] int32 block ids. Returns [B, Hkv, T, (Dh)]
+    arrays elementwise IDENTICAL to the dense slab's layer slice at
+    every written position — a pure gather, no arithmetic — so the
+    shared attention kernels produce bit-identical outputs (unwritten
+    positions differ only where the strict t < pos mask already forces
+    exactly-zero weight)."""
+    out = {}
+    for key, arr in pool_layer.items():
+        g = arr[table]  # [B, nb, Hkv, block, (Dh)]
+        g = jnp.moveaxis(g, 1, 2)  # [B, Hkv, nb, block, (Dh)]
+        shape = g.shape
+        out[key] = g.reshape(
+            shape[0], shape[1], shape[2] * shape[3], *shape[4:]
+        )
+    return out
+
+
+def paged_prefix_view(pool: Cache, table: jnp.ndarray, nb: int) -> Cache:
+    """Stacked-layer dense view of the first `nb` table blocks:
+    pool [L, NB, Hkv, block, (Dh)] + table [B, >=nb] ->
+    {key: [L, B, Hkv, nb*block, (Dh)]} — the paged stand-in for the
+    dense engine's resident-prefix slice cache[:, slots, :, :W]."""
+    tb = table[:, :nb]
+    out = {}
+    for key, arr in pool.items():
+        g = arr[:, tb]  # [L, B, nb, Hkv, block, (Dh)]
+        g = jnp.moveaxis(g, 2, 3)  # [L, B, Hkv, nb, block, (Dh)]
+        shape = g.shape
+        out[key] = g.reshape(
+            shape[0], shape[1], shape[2], shape[3] * shape[4], *shape[5:]
+        )
+    return out
+
+
+def paged_scatter_tokens(
+    pool: Cache, writes: Cache, table: jnp.ndarray, spos: jnp.ndarray
+) -> Cache:
+    """Scatter per-token KV writes through block tables.
+
+    writes: {key: [L, B, Hkv, S, (Dh)]} landing at absolute positions
+    spos [B, S]; table [B, NBs]. The flat position decomposes into
+    (block id via the table, offset inside the block); advanced indices
+    on dims 1 and 3 land in front exactly like the dense engine's
+    cache[:, slots[:, None], :, spos] scatter, so the update operand is
+    the same moveaxis. Rows whose table entry is 0 (unallocated tail of
+    a padded bucket) write into the reserved trash block — same
+    harmless-garbage discipline as the dense slab's pad writes, hence
+    no unique_indices claim (trash collisions are fine). Positions past
+    the table's window are routed to the trash block explicitly: the
+    dense scatter DROPS out-of-bounds rows, but take_along_axis CLAMPS,
+    which would silently corrupt the row's last real block."""
+    block = pool["k"].shape[3]
+    idx = spos // block  # [B, S]
+    bids = jnp.where(
+        idx < table.shape[1],
+        jnp.take_along_axis(
+            table, jnp.minimum(idx, table.shape[1] - 1), axis=1
+        ),
+        0,
+    )
+    offs = spos % block
+    return {
+        key: pool[key].at[:, bids, :, offs].set(
+            jnp.moveaxis(writes[key], (1, 3), (0, 1)).astype(pool[key].dtype)
+        )
+        for key in pool
+    }
+
+
+def _run_blocks_decode_paged(params, x, cfg, positions, inv_freq, pos,
+                             pool, table):
+    """Paged twin of _run_blocks_decode: per layer, K/V are GATHERED
+    through the block table into the dense head-major view and fed to
+    the SAME gqa_attention_decode — a pure relayout, so greedy decode is
+    bit-identical to the slab path. The pool rides the scan as xs (read-
+    only per-layer slices, like the dense cache) and all L layers' fresh
+    k/v land after the scan in one batched scatter at the flat
+    (table[pos // block], pos % block) address."""
+    quantized = cfg.kv_cache_dtype == "int8"
+    block = pool["k"].shape[3]
+    Smax = table.shape[1] * block
+    mask_lt = jnp.arange(Smax)[None, None, :] < pos[:, None, None]
+
+    def body(carry, xs):
+        bp, pl = xs
+        h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        cl = paged_gather_kv(pl, table)
+        attn = gqa_attention_decode(
+            q, cl["k"], cl["v"], k, v, mask_lt,
+            k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
+        )
+        x = carry + _qdot(attn, bp, "wo", cfg)
+        x, aux = _mlp_res(x, bp, cfg, None)
+        if quantized:
+            kq, ksc = _quantize_kv(k[:, 0])
+            vq, vsc = _quantize_kv(v[:, 0])
+            fresh = {"k": kq, "v": vq, "k_scale": ksc, "v_scale": vsc}
+        else:
+            dt = pool["k"].dtype
+            fresh = {"k": k[:, 0].astype(dt), "v": v[:, 0].astype(dt)}
+        return x, (fresh, aux)
+
+    x, (fresh, aux) = jax.lax.scan(body, x, (params["blocks"], pool))
+    rows = jnp.arange(pos.shape[0])
+    idx = pos // block
+    # pos can sit AT Smax for rows admitted with a full-window prompt
+    # (first_done, frozen): the dense scatter drops that OOB write, so
+    # the paged one must route it to trash — plain indexing would clamp
+    # into the row's last (possibly trie-shared) block.
+    bid = jnp.where(
+        idx < table.shape[1],
+        table[rows, jnp.minimum(idx, table.shape[1] - 1)],
+        0,
+    )
+    off = pos % block
+    # Same one-scatter-for-all-layers shape as the dense write: advanced
+    # indices (bid on dim 1, off on dim 3) land in front, update operand
+    # is fresh[key] [L, B, Hkv, (Dh)] with B swapped forward. Inactive
+    # rows write through table entry 0 (trash) — collisions allowed.
+    new_pool = {
+        key: pool[key].at[:, bid, :, off].set(
+            jnp.swapaxes(fresh[key], 0, 1)
+        )
+        for key in pool
+    }
+    return x, new_pool, jnp.mean(aux)
+
+
+def paged_decode_step(
+    params: Params,
+    token: jnp.ndarray,  # [B] int32 current tokens
+    pos: jnp.ndarray,  # [B] int32 positions to write at
+    pool: Cache,  # [L, NB, Hkv, block, (Dh)] global block pool
+    table: jnp.ndarray,  # [B, Smax // block] int32 block tables
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, Cache]:
+    """One autoregressive step over the paged pool. Returns
+    (logits [B, V], updated pool) — the block-table twin of decode_step,
+    bit-identical for greedy outputs."""
+    x = _embed_rows(params, token, _dtype(cfg))[:, None, :]
+    positions = pos[:, None]
+    inv_freq = rope_frequencies(cfg)
+    x, pool, _ = _run_blocks_decode_paged(params, x, cfg, positions,
+                                          inv_freq, pos, pool, table)
+    return _logits(params, x, cfg)[:, 0], pool
+
+
 def prefill(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] right-padded prompts

@@ -91,6 +91,18 @@ class EngineConfig:
     chunked_prefill: bool = False
     prefill_chunk: int = 128  # power of two, multiple of prefix_block
     dispatch_token_budget: int = 0  # prefill tokens per dispatch; 0 -> chunk
+    # Paged KV cache (opt-in): replace the per-slot contiguous KV slab
+    # with a global block pool + per-slot block tables, so a stream
+    # allocates KV in `kv_block`-token blocks as it decodes instead of
+    # reserving max_seq_len up front — short-decode traffic packs several
+    # times more concurrent streams into the same HBM budget, and prefix-
+    # cache hits share prompt blocks zero-copy (refcounts, not device
+    # copies; copy-on-write when a stream writes into a partially-filled
+    # shared block). False keeps the dense dispatch path byte-identical.
+    # Single-process meshes only (host-side allocator, like prefix_cache).
+    paged_kv: bool = False
+    kv_block: int = 16  # tokens per pool block; power of two
+    kv_pool_blocks: int = 0  # pool size incl. trash block; 0 -> dense-equiv
 
     def __post_init__(self):
         def pow2(n: int) -> bool:
@@ -135,6 +147,43 @@ class EngineConfig:
                     f"({self.prefill_chunk}) — a dispatch must fit at least "
                     f"one chunk to make progress"
                 )
+        if self.paged_kv:
+            if not pow2(self.kv_block):
+                raise ValueError(
+                    f"kv_block ({self.kv_block}) must be a power of two — "
+                    f"block offsets are computed with pow2 div/mod"
+                )
+            if self.kv_block % self.prefix_block:
+                raise ValueError(
+                    f"kv_block ({self.kv_block}) must be a multiple of "
+                    f"prefix_block ({self.prefix_block}) so trie spans never "
+                    f"straddle a pool block"
+                )
+            if self.max_seq_len % self.kv_block:
+                raise ValueError(
+                    f"max_seq_len ({self.max_seq_len}) must be a multiple of "
+                    f"kv_block ({self.kv_block}) — block tables are "
+                    f"max_seq_len / kv_block entries wide"
+                )
+            if any(b % self.kv_block for b in self.prompt_buckets):
+                raise ValueError(
+                    f"every prompt_buckets entry ({self.prompt_buckets}) "
+                    f"must be a multiple of kv_block ({self.kv_block}) — "
+                    f"warm prefix widths are bucketed and must cover whole "
+                    f"pool blocks"
+                )
+            if self.chunked_prefill and self.prefill_chunk % self.kv_block:
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must be a "
+                    f"multiple of kv_block ({self.kv_block}) under paged_kv "
+                    f"so chunk boundaries append whole pool blocks"
+                )
+            if self.kv_pool_blocks and self.kv_pool_blocks < 2:
+                raise ValueError(
+                    f"kv_pool_blocks ({self.kv_pool_blocks}) must be >= 2 "
+                    f"(1 reserved trash block + 1 usable) or 0 for the "
+                    f"dense-equivalent budget"
+                )
 
 
 @dataclasses.dataclass
@@ -164,6 +213,10 @@ class _Request:
     # must skip it — no tokens exist yet and device `active` is False).
     prefill_done: int = 0
     prefilling: bool = False
+    # Paged-KV state: every pool block this request's table row points
+    # at — owned and zero-copy-shared alike each carry one allocator ref
+    # taken at admission/growth, so release is a uniform unref sweep.
+    block_ids: List[int] = dataclasses.field(default_factory=list)
     # Observability: when the scheduler first dispatched work for this
     # request (queue-wait = first_dispatch_at - submitted_at) and when its
     # latest token burst was emitted (drives the ITL histogram).
@@ -210,6 +263,21 @@ class EngineStats:
         self.budget_dispatches = 0
         self.budget_tokens = 0
         self.budget_limit = 0
+        # Paged-KV observability: admissions whose warm prefix was shared
+        # by refcount alone (no device KV traffic), copy-on-write block
+        # copies, admissions stalled on pool exhaustion, streams preempted
+        # to free blocks for an active decoder, and — for contrast — warm
+        # admissions that DID move prefix KV through the device (dense
+        # gather/seed paths; provably zero in paged mode).
+        self.zero_copy_admissions = 0
+        self.cow_copies = 0
+        self.pool_stalls = 0
+        self.preemptions = 0
+        self.prefix_seed_copies = 0
+        # Set by the paged engine to the allocator's snapshot() — merged
+        # into snapshot() as pool_blocks_* gauges (zeros when dense, so
+        # the prometheus surface is unconditional).
+        self.pool_gauges = None
 
     def record_itl_locked(self, ms: float) -> None:
         """Caller holds self.lock."""
@@ -236,9 +304,22 @@ class EngineStats:
         return 2.0 * self.itl_edges_ms[-1]
 
     def snapshot(self) -> Dict[str, float]:
+        pool = (
+            self.pool_gauges() if self.pool_gauges is not None
+            else {"total": 0, "used": 0, "free": 0, "shared": 0}
+        )
         with self.lock:
             itl_count = sum(self.itl_counts)
             return {
+                "pool_blocks_total": pool["total"],
+                "pool_blocks_used": pool["used"],
+                "pool_blocks_free": pool["free"],
+                "pool_blocks_shared": pool["shared"],
+                "zero_copy_admissions": self.zero_copy_admissions,
+                "cow_copies": self.cow_copies,
+                "pool_stalls": self.pool_stalls,
+                "preemptions": self.preemptions,
+                "prefix_seed_copies": self.prefix_seed_copies,
                 "requests": self.requests,
                 "completed": self.completed,
                 "tokens_out": self.tokens_out,
@@ -298,6 +379,36 @@ class InferenceEngine:
             b for b in self.ecfg.prompt_buckets if b <= Smax
         ) or (Smax,)
 
+        # Paged KV cache (opt-in, single-process only — the block
+        # allocator and tables are host-side state, and multi-process
+        # SPMD dispatch decisions must be identical on every host). When
+        # enabled, state["cache"] holds one global block pool
+        # [L, NB, Hkv, kv_block, (Dh)] instead of the per-slot slab, and
+        # every dispatch site branches to a paged twin that reads/writes
+        # KV through per-slot int32 block tables. paged_kv=False leaves
+        # every dense code path byte-identical.
+        self._paged = bool(self.ecfg.paged_kv)
+        if self._paged and jax.process_count() > 1:
+            logger.warning(
+                "paged_kv disabled: host-side block allocator requires a "
+                "single-process mesh"
+            )
+            self._paged = False
+        self._paged_prefix = None
+        if self._paged:
+            from seldon_tpu.servers.block_pool import BlockAllocator
+
+            self._kv_block = self.ecfg.kv_block
+            self._nbs = Smax // self._kv_block  # block-table width
+            # Default pool: the dense slab's exact token budget
+            # (B * Smax tokens) plus the reserved trash block — same HBM,
+            # but blocks only bind to streams as they are written.
+            self._num_blocks = (
+                self.ecfg.kv_pool_blocks or B * self._nbs + 1
+            )
+            self._allocator = BlockAllocator(self._num_blocks)
+            self._table_host = np.zeros((B, self._nbs), np.int32)
+
         self._state = self._fresh_state()
         self._active_host = np.zeros((B,), bool)  # control-flow mirror
         # Serializes slot/free-list/active bookkeeping between the
@@ -318,6 +429,8 @@ class InferenceEngine:
         self._rid = 0
         self._rid_lock = threading.Lock()
         self.stats = EngineStats()
+        if self._paged:
+            self.stats.pool_gauges = self._allocator.snapshot
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -351,7 +464,20 @@ class InferenceEngine:
         self._prefix = None
         self._jit_admit_sub = None
         self._jit_admit_prefix = None
-        if self.ecfg.prefix_cache:
+        if self.ecfg.prefix_cache and self._paged:
+            # Paged engines index BLOCK IDS, not KV copies: warm hits
+            # refcount cached blocks straight into the new slot's table
+            # (zero-copy); the dense PrefixIndex machinery below (gather,
+            # seed, insert-with-KV) never runs, so self._prefix stays
+            # None and every `_prefix is not None` dense branch stays off.
+            from seldon_tpu.servers.prefix_cache import PagedPrefixIndex
+
+            self._paged_prefix = PagedPrefixIndex(
+                block=self.ecfg.prefix_block,
+                kv_block=self._kv_block,
+                allocator=self._allocator,
+            )
+        elif self.ecfg.prefix_cache:
             if jax.process_count() > 1:
                 logger.warning(
                     "prefix_cache disabled: host-side KV index requires a "
@@ -386,24 +512,54 @@ class InferenceEngine:
         self._prefilling: Deque[_Request] = collections.deque()
         self._jit_admit_chunk = None
         self._jit_seed_prefix = None
+        self._jit_admit_chunk_paged = None
         if self._chunked:
             C = min(self.ecfg.prefill_chunk, max(self._buckets))
             self._prefill_chunk = C
             self._chunk_buckets = tuple(sorted(
                 {min(b, C) for b in self._buckets} | {C}
             ))
-            self._jit_admit_chunk = jax.jit(
-                functools.partial(
-                    self._admit_chunk_impl, cfg=self.cfg, mesh=mesh,
-                    return_sub=self._prefix is not None,
-                ),
-                static_argnames=("prefix_width",),
-                donate_argnums=(1,),
-            )
+            if self._paged:
+                self._jit_admit_chunk_paged = jax.jit(
+                    functools.partial(
+                        self._paged_admit_chunk_impl, cfg=self.cfg,
+                        mesh=mesh,
+                    ),
+                    static_argnames=("prefix_width",),
+                    donate_argnums=(1,),
+                )
+            else:
+                self._jit_admit_chunk = jax.jit(
+                    functools.partial(
+                        self._admit_chunk_impl, cfg=self.cfg, mesh=mesh,
+                        return_sub=self._prefix is not None,
+                    ),
+                    static_argnames=("prefix_width",),
+                    donate_argnums=(1,),
+                )
             if self._prefix is not None:
                 self._jit_seed_prefix = jax.jit(
                     self._seed_prefix_impl, donate_argnums=(0,)
                 )
+        # Paged dispatch twins: one-shot admission (cold AND warm — the
+        # static prefix_width keys the variant, 0 = cold), the block-
+        # table decode chunk ladder, and the copy-on-write block copy.
+        # The block table is passed as a fresh device array per dispatch
+        # (never donated); the pool itself lives inside the donated state.
+        self._jit_admit_paged = None
+        self._jit_chunks_paged = None
+        self._jit_cow = None
+        if self._paged:
+            self._jit_admit_paged = jax.jit(
+                functools.partial(
+                    self._paged_admit_impl, cfg=self.cfg, mesh=mesh,
+                ),
+                static_argnames=("prefix_width",),
+                donate_argnums=(1,),
+            )
+            self._jit_cow = jax.jit(
+                self._cow_copy_impl, donate_argnums=(0,)
+            )
         # Chunk-length ladder: exactly the three rungs the policy uses
         # (min / geometric mid / top) — every rung costs a full chunk
         # compile, so no speculative intermediates.
@@ -428,11 +584,30 @@ class InferenceEngine:
             )
             for n in self._chunk_sizes
         }
+        if self._paged:
+            self._jit_chunks_paged = {
+                n: jax.jit(
+                    functools.partial(
+                        self._paged_chunk_impl,
+                        cfg=self.cfg,
+                        n_steps=n,
+                        mesh=mesh,
+                    ),
+                    donate_argnums=(1,),
+                )
+                for n in self._chunk_sizes
+            }
 
     def _fresh_state(self) -> Dict[str, Any]:
         B, Smax = self.ecfg.max_slots, self.ecfg.max_seq_len
+        if self._paged:
+            cache = transformer.init_paged_cache(
+                self.cfg, self._num_blocks, self._kv_block
+            )
+        else:
+            cache = transformer.init_cache(self.cfg, B, Smax)
         return {
-            "cache": transformer.init_cache(self.cfg, B, Smax),
+            "cache": cache,
             "last_tok": jnp.zeros((B,), jnp.int32),
             "pos": jnp.zeros((B,), jnp.int32),
             "active": jnp.zeros((B,), jnp.bool_),
@@ -741,6 +916,204 @@ class InferenceEngine:
         )
         return state, toks, valid, active
 
+    # --- paged-KV kernels ---------------------------------------------------
+
+    @staticmethod
+    def _paged_admit_impl(
+        params, state, table, toks, plens, prefix_lens, seeds, temps,
+        top_ks, top_ps, max_news, slots, *, prefix_width, cfg, mesh=None,
+    ):
+        """Paged fused admission — ONE kernel covers cold and warm.
+
+        prefix_width == 0 (cold): full-prompt prefill into a scratch
+        cache, exactly _admit_impl's math, then the writes scatter into
+        the pool THROUGH the group's block tables instead of contiguous
+        slot rows. prefix_width > 0 (warm): the reused prefix is a pure
+        GATHER of the table's first prefix_width/kv_block blocks — the
+        blocks a zero-copy admission just refcounted from the trie — fed
+        to the same prefill_with_prefix as the dense warm path, so greedy
+        outputs stay bit-identical while the admission moves no prefix
+        KV at all. Suffix positions past a row's allocated blocks route
+        to the trash block (paged_scatter_tokens), mirroring the dense
+        path's dropped OOB scatter rows."""
+        G, Sb = toks.shape
+        pool = state["cache"]
+        block = pool["k"].shape[3]
+        Smax = table.shape[1] * block
+        if prefix_width:
+            prefix_kv = transformer.paged_prefix_view(
+                pool, table, prefix_width // block
+            )
+            logits, kv = transformer.prefill_with_prefix(
+                params, toks, plens, prefix_kv, prefix_lens, cfg
+            )
+            if cfg.kv_cache_dtype == "int8":
+                kq, ks = transformer._quantize_kv(kv["k"])
+                vq, vs = transformer._quantize_kv(kv["v"])
+                writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+            else:
+                dt = pool["k"].dtype
+                writes = {"k": kv["k"].astype(dt), "v": kv["v"].astype(dt)}
+            spos = prefix_lens[:, None] + jnp.arange(Sb)[None, :]
+        else:
+            sub = transformer.init_cache(cfg, G, Sb)
+            logits, writes = transformer.prefill(params, toks, plens, sub,
+                                                 cfg)
+            spos = jnp.broadcast_to(jnp.arange(Sb)[None, :], (G, Sb))
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(seeds, plens)
+        first = sample_per_row(logits, keys, temps, top_ks, top_ps)
+        first_done = (
+            (first == cfg.eos_token_id)
+            | (max_news <= 1)
+            | (plens + 1 >= Smax)
+        )
+        new_pool = transformer.paged_scatter_tokens(pool, writes, table,
+                                                    spos)
+        new_state = {
+            "cache": new_pool,
+            "last_tok": state["last_tok"].at[slots].set(first),
+            "pos": state["pos"].at[slots].set(plens),
+            "active": state["active"].at[slots].set(~first_done),
+            "temp": state["temp"].at[slots].set(temps),
+            "top_k": state["top_k"].at[slots].set(top_ks),
+            "top_p": state["top_p"].at[slots].set(top_ps),
+            "seeds": state["seeds"].at[slots].set(seeds),
+            "remaining": state["remaining"].at[slots].set(max_news - 1),
+        }
+        first, first_done = InferenceEngine._replicate(
+            mesh, first, first_done
+        )
+        return new_state, first, first_done
+
+    @staticmethod
+    def _paged_admit_chunk_impl(
+        params, state, table, toks, plens, starts, seeds, temps, top_ks,
+        top_ps, max_news, slots, finals, *, prefix_width, cfg, mesh=None,
+    ):
+        """Paged twin of _admit_chunk_impl: the resident KV of chunks
+        0..k-1 (and any zero-copy warm prefix) is a block-table GATHER of
+        each row's first prefix_width/kv_block blocks instead of a slab
+        slice, and the fresh chunk KV scatters back through the table.
+        Attention math, sampling keys, and slot-state writes are
+        identical, so greedy outputs match the dense chunked path
+        bit-for-bit. No writes are returned — paged trie insertion is
+        host-side block bookkeeping, not device KV."""
+        G, Sc = toks.shape
+        pool = state["cache"]
+        block = pool["k"].shape[3]
+        Smax = table.shape[1] * block
+        prefix_kv = transformer.paged_prefix_view(
+            pool, table, prefix_width // block
+        )
+        logits, kv = transformer.prefill_with_prefix(
+            params, toks, plens, prefix_kv, starts, cfg
+        )
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(seeds, plens)
+        first = sample_per_row(logits, keys, temps, top_ks, top_ps)
+        first_done = (
+            (first == cfg.eos_token_id)
+            | (max_news <= 1)
+            | (plens + 1 >= Smax)
+        )
+        new_pos = jnp.minimum(plens, starts + Sc)
+        if cfg.kv_cache_dtype == "int8":
+            kq, ks = transformer._quantize_kv(kv["k"])
+            vq, vs = transformer._quantize_kv(kv["v"])
+            writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+        else:
+            dt = pool["k"].dtype
+            writes = {"k": kv["k"].astype(dt), "v": kv["v"].astype(dt)}
+        spos = starts[:, None] + jnp.arange(Sc)[None, :]
+        new_pool = transformer.paged_scatter_tokens(pool, writes, table,
+                                                    spos)
+        new_state = {
+            "cache": new_pool,
+            "last_tok": state["last_tok"].at[slots].set(first),
+            "pos": state["pos"].at[slots].set(new_pos),
+            "active": state["active"].at[slots].set(finals & ~first_done),
+            "temp": state["temp"].at[slots].set(temps),
+            "top_k": state["top_k"].at[slots].set(top_ks),
+            "top_p": state["top_p"].at[slots].set(top_ps),
+            "seeds": state["seeds"].at[slots].set(seeds),
+            "remaining": state["remaining"].at[slots].set(max_news - 1),
+        }
+        first, first_done = InferenceEngine._replicate(
+            mesh, first, first_done
+        )
+        return new_state, first, first_done
+
+    @staticmethod
+    def _paged_chunk_impl(params, state, table, *, cfg, n_steps, mesh=None):
+        """Paged twin of _chunk_impl: `n_steps` decode iterations reading
+        K/V through the block tables (transformer.paged_decode_step).
+        Per-row termination, sampling keys and masking are identical, so
+        greedy tokens match the dense chunk bit-for-bit. Inactive rows'
+        garbage writes route through table entry 0 (trash) once the host
+        zeroes a freed row — the paged analogue of the dense path's
+        frozen-position scribble."""
+        block = state["cache"]["k"].shape[3]
+        Smax = table.shape[1] * block
+
+        def step(carry, _):
+            run = carry["active"]
+            logits, pool = transformer.paged_decode_step(
+                params, carry["last_tok"], carry["pos"], carry["cache"],
+                table, cfg,
+            )
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
+            )(carry["seeds"], carry["pos"])
+            tok = sample_per_row(
+                logits,
+                keys,
+                carry["temp"],
+                jnp.where(run, carry["top_k"], 0),
+                jnp.where(run, carry["top_p"], 1.0),
+            )
+            tok = jnp.where(run, tok, cfg.pad_token_id)
+            pos = carry["pos"] + run.astype(jnp.int32)
+            remaining = carry["remaining"] - run.astype(jnp.int32)
+            done = run & (
+                (tok == cfg.eos_token_id)
+                | (remaining <= 0)
+                | (pos >= Smax - 1)
+            )
+            new_carry = {
+                **carry,
+                "cache": pool,
+                "last_tok": jnp.where(run, tok, carry["last_tok"]),
+                "pos": pos,
+                "active": carry["active"] & ~done,
+                "remaining": remaining,
+            }
+            return new_carry, (tok, run)
+
+        state, (toks, valid) = jax.lax.scan(step, state, None,
+                                            length=n_steps)
+        toks, valid, active = InferenceEngine._replicate(
+            mesh, toks, valid, state["active"]
+        )
+        return state, toks, valid, active
+
+    @staticmethod
+    def _cow_copy_impl(state, src, dst):
+        """Copy-on-write block copy: duplicate pool block `src` into
+        `dst` (every cache array — k/v and int8 scales). src/dst are
+        traced scalars, so all CoW copies share one compile. Dispatched
+        BEFORE the warm admission that writes into `dst`, and `src` is
+        pinned by the request's trie handle, so device ordering makes
+        the copy race-free."""
+        pool = state["cache"]
+        new_pool = {
+            key: pool[key].at[:, dst].set(pool[key][:, src])
+            for key in pool
+        }
+        return {**state, "cache": new_pool}
+
     # --- public API ---------------------------------------------------------
 
     def submit(
@@ -835,14 +1208,58 @@ class InferenceEngine:
             # lattice instead, plus the per-width prefix seed scatters.
             n_chunk_warm = self._warmup_chunked(sizes)
             for n in self._chunk_sizes:
-                self._state, _, _, _ = self._jit_chunks[n](
-                    self.params, self._state
+                self._state, _, _, _ = self._dispatch_decode_chunk(n)
+            if self._paged:
+                self._state = self._jit_cow(
+                    self._state, jnp.int32(0), jnp.int32(0)
                 )
             jax.block_until_ready(self._state["last_tok"])
             logger.info(
                 "engine warmed: %d prefill-chunk variants + %d decode "
                 "chunk sizes",
                 n_chunk_warm, len(self._chunk_sizes),
+            )
+            return
+        if self._paged:
+            # One paged admission kernel covers cold and warm; warm rows
+            # just gather through an all-trash table (pure compile). The
+            # shared CoW copy compiles once (traced src/dst scalars).
+            widths = (0,)
+            if self._paged_prefix is not None:
+                widths += tuple(
+                    b for b in self._buckets if b < self.ecfg.max_seq_len
+                )
+            n_warm = 0
+            for Sb in self._buckets:
+                for G in sizes:
+                    table = jnp.zeros((G, self._nbs), jnp.int32)
+                    for W in widths:
+                        self._state, _, _ = self._jit_admit_paged(
+                            self.params,
+                            self._state,
+                            table,
+                            jnp.zeros((G, Sb), jnp.int32),
+                            jnp.full((G,), W + 1, jnp.int32),
+                            jnp.full((G,), W, jnp.int32),
+                            jnp.zeros((G,), jnp.uint32),
+                            jnp.ones((G,), jnp.float32),
+                            jnp.zeros((G,), jnp.int32),
+                            jnp.ones((G,), jnp.float32),
+                            jnp.ones((G,), jnp.int32),
+                            jnp.arange(G, dtype=jnp.int32),
+                            prefix_width=W,
+                        )
+                        n_warm += 1
+            self._state = self._jit_cow(
+                self._state, jnp.int32(0), jnp.int32(0)
+            )
+            for n in self._chunk_sizes:
+                self._state, _, _, _ = self._dispatch_decode_chunk(n)
+            jax.block_until_ready(self._state["last_tok"])
+            logger.info(
+                "engine warmed (paged): %d admission variants + %d decode "
+                "chunk sizes",
+                n_warm, len(self._chunk_sizes),
             )
             return
         admit = self._jit_admit_sub if self._prefix is not None \
@@ -890,9 +1307,7 @@ class InferenceEngine:
         # All slots inactive: pure compile + masked no-op writes, one per
         # chunk-ladder rung.
         for n in self._chunk_sizes:
-            self._state, _, _, _ = self._jit_chunks[n](
-                self.params, self._state
-            )
+            self._state, _, _, _ = self._dispatch_decode_chunk(n)
         jax.block_until_ready(self._state["last_tok"])
         logger.info(
             "engine warmed: %d admission variants (+%d prefix-warm) + %d "
@@ -913,9 +1328,7 @@ class InferenceEngine:
             for Sc in self._chunk_buckets:
                 for W in widths:
                     starts = jnp.full((G,), W, jnp.int32)
-                    out = self._jit_admit_chunk(
-                        self.params,
-                        self._state,
+                    args = (
                         jnp.zeros((G, Sc), jnp.int32),
                         jnp.full((G,), W + Sc, jnp.int32),
                         starts,
@@ -926,8 +1339,21 @@ class InferenceEngine:
                         jnp.ones((G,), jnp.int32),
                         jnp.arange(G, dtype=jnp.int32),
                         jnp.ones((G,), jnp.bool_),
-                        prefix_width=W,
                     )
+                    if self._paged:
+                        # All-trash tables keep the compile a no-op write.
+                        out = self._jit_admit_chunk_paged(
+                            self.params,
+                            self._state,
+                            jnp.zeros((G, self._nbs), jnp.int32),
+                            *args,
+                            prefix_width=W,
+                        )
+                    else:
+                        out = self._jit_admit_chunk(
+                            self.params, self._state, *args,
+                            prefix_width=W,
+                        )
                     self._state = out[0]
                     n += 1
         if self._jit_seed_prefix is not None:
@@ -954,11 +1380,15 @@ class InferenceEngine:
         the pre-prefix grouping exactly. The trie lookup runs once per
         request and pins the matched path; the match is capped at
         plen - 1 so at least one suffix token remains to produce the
-        next-token logits."""
-        if self._prefix is None:
+        next-token logits. Paged engines use the block-id trie — same
+        lookup discipline, but a hit later shares blocks instead of
+        gathering KV."""
+        index = self._prefix if self._prefix is not None \
+            else self._paged_prefix
+        if index is None:
             return self._bucket(len(req.tokens)), 0
         if req.prefix_len is None:
-            handle = self._prefix.lookup(
+            handle = index.lookup(
                 req.tokens, max_len=len(req.tokens) - 1
             )
             req.prefix_handle = handle
@@ -1008,12 +1438,27 @@ class InferenceEngine:
             key = self._admit_key(self._waiting[0])
             max_g = min(self._max_admit, len(self._free))
             group: List[_Request] = []
+            reserved = 0
             while (
                 len(group) < max_g
                 and self._waiting
                 and self._admit_key(self._waiting[0]) == key
             ):
+                if self._paged:
+                    # Pool gate BEFORE the pop: the whole group's owned
+                    # blocks must fit (after trie eviction), so dispatch-
+                    # time allocation can never fail mid-group. A head
+                    # request that cannot fit stays queued — admission
+                    # blocks on pool exhaustion, it does not preempt.
+                    need = self._owned_need(self._waiting[0])
+                    if not self._pool_reserve(reserved + need):
+                        break
+                    reserved += need
                 group.append(self._waiting.popleft())
+            if not group:
+                with self.stats.lock:
+                    self.stats.pool_stalls += 1
+                break
             try:
                 admits.append(self._dispatch_admit_group(group, *key))
             except Exception as e:  # bad batch must not kill the loop
@@ -1073,6 +1518,41 @@ class InferenceEngine:
             top_ps[i] = sp.top_p
             max_news[i] = sp.max_new_tokens
             slots[i] = req.slot
+        if self._paged:
+            # Zero-copy admission: fill each row's block table (shared
+            # refs + CoW + fresh allocs — capacity was reserved at group
+            # formation), dispatch any copy-on-write block copies FIRST
+            # (device ordering pins them before the admission's suffix
+            # writes), then run the unified paged admission. Warm rows'
+            # prefix KV is gathered from the pool through the table inside
+            # the kernel — no host-side gather, no seed scatter.
+            cows: List[Tuple[int, int]] = []
+            for req in group:
+                self._paged_admit_blocks(req, cows, cover=len(req.tokens))
+            for src, dst in cows:
+                self._state = self._jit_cow(
+                    self._state, jnp.int32(src), jnp.int32(dst)
+                )
+            table = jnp.asarray(self._table_host[slots])
+            self._state, first, first_done = self._jit_admit_paged(
+                self.params,
+                self._state,
+                table,
+                jnp.asarray(toks),
+                jnp.asarray(plens),
+                jnp.asarray(pref_lens),
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
+                jnp.asarray(top_ks),
+                jnp.asarray(top_ps),
+                jnp.asarray(max_news),
+                jnp.asarray(slots),
+                prefix_width=Pb,
+            )
+            for req in group:
+                self._slots[req.slot] = req
+                self._insert_paged_prompt(req, upto=len(req.tokens))
+            return group, None, first, first_done
         if Pb:
             # Per-row device gather of the pinned trie path, zero-padded
             # to the prefix bucket and stacked on the batch axis (dim 1
@@ -1081,6 +1561,11 @@ class InferenceEngine:
                 self._prefix.gather(group[min(i, G - 1)].prefix_handle, Pb)
                 for i in range(Gp)
             ]
+            with self.stats.lock:
+                # Dense warm admissions MOVE the prefix KV (device
+                # gather + scatter); the paged path's zero-copy claim is
+                # exactly that this counter stays 0 there.
+                self.stats.prefix_seed_copies += G
             prefix_kv = {
                 key: jnp.stack([r[key] for r in rows], axis=1)
                 for key in rows[0]
@@ -1155,6 +1640,174 @@ class InferenceEngine:
                 with self.stats.lock:
                     self.stats.prefix_evictions += evicted
 
+    # --- paged-KV block bookkeeping ----------------------------------------
+
+    def _pool_reserve(self, n: int) -> bool:
+        """True iff n free blocks are (or can be made) available without
+        touching live streams — evicts retained trie prefixes LRU-first.
+        Frees can only ARRIVE between this check and the allocation
+        (single scheduler thread allocates; the fetcher only releases),
+        so a True answer cannot go stale."""
+        if self._allocator.free_count >= n:
+            return True
+        if self._paged_prefix is not None:
+            evicted = self._paged_prefix.evict_for(n)
+            if evicted:
+                with self.stats.lock:
+                    self.stats.prefix_evictions += evicted
+        return self._allocator.free_count >= n
+
+    def _secure_blocks(
+        self, n: int, requester: Optional[_Request] = None,
+        allow_preempt: bool = True,
+    ) -> Optional[List[int]]:
+        """Allocate n blocks, freeing capacity as needed: retained trie
+        prefixes go first (pure cache, LRU), then — decode must make
+        progress — the YOUNGEST live stream is preempted (failed and
+        released; its device row zombies harmlessly against the trash
+        block until `remaining` runs out). Returns None only when even
+        preemption cannot free enough."""
+        while True:
+            if self._pool_reserve(n):
+                got = self._allocator.alloc_many(n)
+                if got is not None:
+                    return got
+            if not allow_preempt:
+                return None
+            victim = None
+            for r in self._slots:
+                if r is None or r.finished or r is requester:
+                    continue
+                at = r.first_dispatch_at or float("inf")
+                if victim is None or at > (
+                    victim.first_dispatch_at or float("inf")
+                ):
+                    victim = r
+            if victim is None:
+                return None
+            with self.stats.lock:
+                self.stats.preemptions += 1
+            logger.warning(
+                "preempting request %d: kv cache pool exhausted",
+                victim.rid,
+            )
+            victim.out.put(
+                {"error": "preempted: kv cache pool exhausted"}
+            )
+            self._complete(victim)
+
+    def _owned_need(self, req: _Request) -> int:
+        """Blocks a one-shot admission must ALLOCATE (vs share): the
+        prompt's full block count minus the zero-copy-shared fully
+        matched blocks. The copy-on-write destination (partial match
+        tail) counts as owned."""
+        bs = self._kv_block
+        total = -(-len(req.tokens) // bs)
+        shared = (req.prefix_len or 0) // bs
+        return total - shared
+
+    def _paged_admit_blocks(self, req: _Request, cows: List[Tuple[int, int]],
+                            cover: int) -> None:
+        """Fill req's block-table row for prompt positions [0, cover):
+        fully matched kv blocks are SHARED by refcount (zero-copy), a
+        partial-block match tail allocates a copy-on-write destination
+        (the device copy is dispatched by the caller before the
+        admission kernel), and the remainder is freshly allocated. Every
+        resulting block id lands in req.block_ids with exactly one ref
+        owned by this request. The caller has already reserved capacity
+        via _pool_reserve/_secure_blocks."""
+        bs = self._kv_block
+        slot = req.slot
+        total = -(-cover // bs)
+        bids: List[int] = []
+        m = req.prefix_len or 0
+        if m and self._paged_prefix is not None:
+            srcs, partial = self._paged_prefix.plan(req.prefix_handle)
+            for i, sbid in enumerate(srcs):
+                self._allocator.ref(sbid)
+                self._table_host[slot, i] = sbid
+                bids.append(sbid)
+            if partial is not None:
+                dst = self._allocator.alloc()
+                if dst is None:
+                    raise RuntimeError("kv cache pool exhausted (cow)")
+                cows.append((partial, dst))
+                self._table_host[slot, len(bids)] = dst
+                bids.append(dst)
+                with self.stats.lock:
+                    self.stats.cow_copies += 1
+            with self.stats.lock:
+                self.stats.zero_copy_admissions += 1
+        for i in range(len(bids), total):
+            bid = self._allocator.alloc()
+            if bid is None:
+                raise RuntimeError("kv cache pool exhausted (admit)")
+            self._table_host[slot, i] = bid
+            bids.append(bid)
+        req.block_ids = bids
+
+    def _release_blocks(self, req: _Request) -> None:
+        """Drop every allocator ref req's table row holds (idempotent).
+        The row is zeroed so in-flight strays land in the trash block;
+        actual block REUSE is ordering-safe because a new owner's
+        admission scatter is dispatched after every kernel that could
+        still read or scribble the block under this request."""
+        if not self._paged or not req.block_ids:
+            return
+        slot = req.slot
+        if 0 <= slot < len(self._slots) and (
+            self._slots[slot] is req or self._slots[slot] is None
+        ):
+            self._table_host[slot, :] = 0
+        for bid in req.block_ids:
+            self._allocator.unref(bid)
+        req.block_ids = []
+
+    def _grow_decode_blocks(self, n: int) -> None:
+        """Before a decode chunk of n steps: extend each active slot's
+        block table to cover the chunk's worst-case write positions
+        (pos <= plen + expected - 1 by the recycling invariant, so this
+        chunk writes at most to plen + expected + n - 2). Slots that
+        cannot be grown even after trie eviction + preempting younger
+        streams are failed — every active stream owns at least one
+        exclusive block, so the loop always makes progress."""
+        bs = self._kv_block
+        for slot, req in enumerate(self._slots):
+            if req is None or req.finished or req.prefilling:
+                continue
+            maxpos = min(
+                len(req.tokens) + req.expected + n - 2,
+                self.ecfg.max_seq_len - 1,
+            )
+            need = min(self._nbs, maxpos // bs + 1)
+            have = len(req.block_ids)
+            if need <= have:
+                continue
+            got = self._secure_blocks(need - have, requester=req)
+            if got is None:
+                req.out.put({"error": "kv cache pool exhausted"})
+                self._complete(req)
+                continue
+            for j, bid in enumerate(got):
+                self._table_host[slot, have + j] = bid
+            req.block_ids.extend(got)
+
+    def _insert_paged_prompt(self, req: _Request, upto: int) -> None:
+        """Extend the paged trie over req's prompt blocks [0, upto):
+        new nodes record (and ref) the pool block the slot's table maps
+        their span to — pure host bookkeeping, no device KV moves."""
+        if self._paged_prefix is None:
+            return
+        bs, pb = self._kv_block, self.ecfg.prefix_block
+        slot = req.slot
+
+        def block_of(j: int) -> int:
+            return int(self._table_host[slot, (j * pb) // bs])
+
+        self._paged_prefix.insert(
+            req.tokens[:upto], block_of, handle=req.prefix_handle
+        )
+
     # --- chunked-prefill scheduling ----------------------------------------
 
     def _chunk_bucket(self, n: int) -> int:
@@ -1172,6 +1825,25 @@ class InferenceEngine:
         req.slot = self._free.pop()
         req.prefilling = True
         self._slots[req.slot] = req
+        if self._paged:
+            if self._paged_prefix is not None:
+                self._admit_key(req)  # trie lookup + pin; sets prefix_len
+                if req.prefix_len:
+                    # Warm start is pure table surgery: ref the matched
+                    # blocks, CoW the partial tail — chunk 0 then starts
+                    # at the first uncached token with zero device KV
+                    # traffic. Later chunks allocate their blocks at
+                    # dispatch (_dispatch_chunk_group).
+                    cows: List[Tuple[int, int]] = []
+                    self._paged_admit_blocks(
+                        req, cows, cover=req.prefix_len
+                    )
+                    for src, dst in cows:
+                        self._state = self._jit_cow(
+                            self._state, jnp.int32(src), jnp.int32(dst)
+                        )
+                    req.prefill_done = req.prefix_len
+            return
         if self._prefix is not None:
             self._admit_key(req)  # trie lookup + pin; sets prefix_len
             if req.prefix_len:
@@ -1181,6 +1853,8 @@ class InferenceEngine:
                     self._state, pkv, jnp.int32(req.slot)
                 )
                 req.prefill_done = req.prefix_len
+                with self.stats.lock:
+                    self.stats.prefix_seed_copies += 1
 
     def _collect_chunk_work(
         self, left: int
@@ -1204,6 +1878,15 @@ class InferenceEngine:
                 rem = len(req.tokens)
                 est = C if rem > C else self._chunk_bucket(rem)
                 if est > left:
+                    break
+                if self._paged and not self._pool_reserve(
+                    min(est, rem) // self._kv_block + 2
+                ):
+                    # First chunk's blocks (+ a possible CoW tail) must
+                    # fit before the slot pop — admissions stall on pool
+                    # exhaustion rather than half-admit.
+                    with self.stats.lock:
+                        self.stats.pool_stalls += 1
                     break
                 self._waiting.popleft()
                 self._admit_chunk_slot(req)
@@ -1260,26 +1943,65 @@ class InferenceEngine:
             max_news[i] = sp.max_new_tokens
             slots[i] = req.slot
             finals[i] = final
-        out = self._jit_admit_chunk(
-            self.params,
-            self._state,
-            jnp.asarray(toks),
-            jnp.asarray(plens),
-            jnp.asarray(starts),
-            jnp.asarray(seeds),
-            jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
-            jnp.asarray(max_news),
-            jnp.asarray(slots),
-            jnp.asarray(finals),
-            prefix_width=W,
-        )
-        if self._prefix is not None:
-            self._state, first, first_done, writes = out
-        else:
+        if self._paged:
+            # Append this chunk's pool blocks to each row's table before
+            # dispatch (trie eviction, then preemption of younger
+            # streams, backstop the allocation — a chunk must never
+            # scatter real KV into the trash block).
+            bs = self._kv_block
+            for req, _, _, _, clen in rows:
+                need = min(
+                    self._nbs, -(-(req.prefill_done + clen) // bs)
+                )
+                have = len(req.block_ids)
+                if need > have:
+                    got = self._secure_blocks(need - have, requester=req)
+                    if got is None:
+                        raise RuntimeError(
+                            "kv cache pool exhausted (prefill chunk)"
+                        )
+                    for j, bid in enumerate(got):
+                        self._table_host[req.slot, have + j] = bid
+                    req.block_ids.extend(got)
+            out = self._jit_admit_chunk_paged(
+                self.params,
+                self._state,
+                jnp.asarray(self._table_host[slots]),
+                jnp.asarray(toks),
+                jnp.asarray(plens),
+                jnp.asarray(starts),
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
+                jnp.asarray(top_ks),
+                jnp.asarray(top_ps),
+                jnp.asarray(max_news),
+                jnp.asarray(slots),
+                jnp.asarray(finals),
+                prefix_width=W,
+            )
             self._state, first, first_done = out
             writes = None
+        else:
+            out = self._jit_admit_chunk(
+                self.params,
+                self._state,
+                jnp.asarray(toks),
+                jnp.asarray(plens),
+                jnp.asarray(starts),
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
+                jnp.asarray(top_ks),
+                jnp.asarray(top_ps),
+                jnp.asarray(max_news),
+                jnp.asarray(slots),
+                jnp.asarray(finals),
+                prefix_width=W,
+            )
+            if self._prefix is not None:
+                self._state, first, first_done, writes = out
+            else:
+                self._state, first, first_done = out
+                writes = None
         finals_l = []
         for req, _, _, final, clen in rows:
             req.prefill_done += clen
@@ -1289,6 +2011,10 @@ class InferenceEngine:
                 req.expected = 1  # the final chunk samples the first token
             else:
                 self._prefilling.append(req)
+            if self._paged:
+                # Paged trie insertion is host bookkeeping: record the
+                # blocks this chunk just filled (no device KV moves).
+                self._insert_paged_prompt(req, upto=req.prefill_done)
         if writes is not None:
             self._insert_chunk_kv(rows, writes)
         return group, finals_l, first, first_done
@@ -1456,11 +2182,16 @@ class InferenceEngine:
         if req.finished:
             return
         req.finished = True
-        if req.prefix_handle is not None and self._prefix is not None:
+        if req.prefix_handle is not None:
             # Unpin the trie path — the slot no longer depends on it, so
             # LRU eviction may reclaim it under budget pressure.
-            self._prefix.release(req.prefix_handle)
+            index = self._prefix if self._prefix is not None \
+                else self._paged_prefix
+            if index is not None:
+                index.release(req.prefix_handle)
             req.prefix_handle = None
+        if self._paged:
+            self._release_blocks(req)
         req.out.put(None)
         slot = req.slot
         if 0 <= slot < len(self._slots) and self._slots[slot] is req:
@@ -1498,6 +2229,28 @@ class InferenceEngine:
         self._free = list(range(B))
         self._active_host[:] = False
         self._prefilling.clear()  # mid-prefill requests failed via _slots
+        if self._paged:
+            # The sweep above unreffed every live request's blocks into
+            # the old allocator; rebuild pool bookkeeping wholesale so it
+            # matches the fresh device state (trie refs included).
+            from seldon_tpu.servers.block_pool import BlockAllocator
+            self._allocator = BlockAllocator(self._num_blocks)
+            self.stats.pool_gauges = self._allocator.snapshot
+            self._table_host[:] = 0
+            if self._paged_prefix is not None:
+                from seldon_tpu.servers.prefix_cache import \
+                    PagedPrefixIndex
+                self._paged_prefix = PagedPrefixIndex(
+                    block=self.ecfg.prefix_block,
+                    kv_block=self._kv_block,
+                    allocator=self._allocator,
+                )
+            # Still-waiting requests may hold handles into the old trie;
+            # drop them so admission re-looks-up against the new one.
+            for req in self._waiting:
+                req.prefix_handle = None
+                req.prefix_len = None
+                req.block_ids = []
         self._state = self._fresh_state()
 
     def _process_boundary(self, admits, chunk_handles, roster) -> None:
@@ -1575,6 +2328,12 @@ class InferenceEngine:
                     self._slots[slot] = None
                     self._active_host[slot] = False
                     self._free.append(slot)
+                    if self._paged:
+                        # Return the row's blocks now: the just-dispatched
+                        # chunk freezes this row at its budget, and any new
+                        # owner's admission scatter is queued after it —
+                        # the zombie row only touches the trash block.
+                        self._release_blocks(req)
 
     def _drain_and_fail(self, err: str, current=None) -> None:
         """Async-mode failure: drain every queued boundary (their rosters
@@ -1634,6 +2393,19 @@ class InferenceEngine:
         else:
             self._loop_sync()
 
+    def _dispatch_decode_chunk(self, n: int):
+        """Dispatch one n-step decode chunk. Dense engines call the slab
+        kernel unchanged; paged engines first grow each live row's block
+        table to cover the chunk's worst-case positions (evicting /
+        preempting on exhaustion), then pass the fresh tables alongside
+        the donated state."""
+        if self._paged:
+            self._grow_decode_blocks(n)
+            return self._jit_chunks_paged[n](
+                self.params, self._state, jnp.asarray(self._table_host)
+            )
+        return self._jit_chunks[n](self.params, self._state)
+
     def _dispatch_once(self):
         """One scheduling step under the bookkeeping lock. Returns the
         (admits, chunk_handles, roster) boundary or None if idle. On an
@@ -1649,8 +2421,8 @@ class InferenceEngine:
             roster = self._roster()
             self._dispatch_wreck = (admits, None, roster)
             n = self._pick_chunk()
-            self._state, toks, valid, active_after = self._jit_chunks[n](
-                self.params, self._state
+            self._state, toks, valid, active_after = (
+                self._dispatch_decode_chunk(n)
             )
             with self.stats.lock:
                 self.stats.decode_dispatches += 1
@@ -1710,7 +2482,7 @@ class InferenceEngine:
                     roster = self._roster()
                     n = self._pick_chunk()
                     self._state, toks, valid, active_after = (
-                        self._jit_chunks[n](self.params, self._state)
+                        self._dispatch_decode_chunk(n)
                     )
                     chunk_handles = (toks, valid, active_after)
                     with self.stats.lock:

@@ -56,6 +56,9 @@ class JAXServer(SeldonComponent):
         chunked_prefill: int = -1,
         prefill_chunk: int = 0,
         dispatch_token_budget: int = 0,
+        paged_kv: int = -1,
+        kv_block: int = 0,
+        kv_pool_mb: int = 0,
     ):
         self.model_uri = model_uri
         self.preset = preset
@@ -103,6 +106,20 @@ class JAXServer(SeldonComponent):
         self.dispatch_token_budget = int(
             dispatch_token_budget
             or _os.environ.get("DISPATCH_TOKEN_BUDGET", "0") or 0
+        )
+        # Paged KV cache (servers/engine.py block pool): unit parameter,
+        # or PAGED_KV=1 / KV_BLOCK / KV_POOL_MB env. KV_POOL_MB sizes the
+        # pool in HBM megabytes (converted to blocks once the model
+        # config is known in load()); 0 keeps the dense-equivalent
+        # budget of max_slots * max_seq_len tokens.
+        if int(paged_kv) < 0:
+            paged_kv = int(_os.environ.get("PAGED_KV", "0") or 0)
+        self.paged_kv = bool(int(paged_kv))
+        self.kv_block = int(
+            kv_block or _os.environ.get("KV_BLOCK", "0") or 0
+        )
+        self.kv_pool_mb = int(
+            kv_pool_mb or _os.environ.get("KV_POOL_MB", "0") or 0
         )
         self._loaded = False
         self._load_lock = threading.Lock()
@@ -222,6 +239,26 @@ class JAXServer(SeldonComponent):
                     ekw["prefill_chunk"] = self.prefill_chunk
                 if self.dispatch_token_budget:
                     ekw["dispatch_token_budget"] = self.dispatch_token_budget
+            if self.paged_kv:
+                ekw["paged_kv"] = True
+                kb = self.kv_block or EngineConfig.kv_block
+                ekw["kv_block"] = kb
+                # Warm prefix widths are bucketed and must cover whole
+                # pool blocks (EngineConfig validation).
+                buckets = tuple(b for b in buckets if b % kb == 0) \
+                    or (seq,)
+                if self.kv_pool_mb:
+                    # blocks = pool_bytes /
+                    #   (2 * layers * kv_heads * head_dim * kv_block * B)
+                    # where B is the KV dtype width; int8 adds one bf16
+                    # scale per (head, token) on top of the 1-byte values.
+                    per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * (
+                        cfg.head_dim * (1 if cfg.kv_cache_dtype == "int8"
+                                        else 2)
+                        + (2 if cfg.kv_cache_dtype == "int8" else 0)
+                    )
+                    blocks = (self.kv_pool_mb << 20) // (per_tok * kb)
+                    ekw["kv_pool_blocks"] = max(2, int(blocks))
             self.engine = InferenceEngine(
                 params,
                 cfg,
@@ -451,6 +488,20 @@ class JAXServer(SeldonComponent):
              "value": float(s["prefill_chunk_tokens"])},
             {"type": "GAUGE", "key": "jaxserver_budget_utilization",
              "value": s["budget_utilization"]},
+            {"type": "GAUGE", "key": "jaxserver_pool_blocks_used",
+             "value": float(s["pool_blocks_used"])},
+            {"type": "GAUGE", "key": "jaxserver_pool_blocks_free",
+             "value": float(s["pool_blocks_free"])},
+            {"type": "GAUGE", "key": "jaxserver_pool_blocks_shared",
+             "value": float(s["pool_blocks_shared"])},
+            {"type": "GAUGE", "key": "jaxserver_zero_copy_admissions",
+             "value": float(s["zero_copy_admissions"])},
+            {"type": "GAUGE", "key": "jaxserver_cow_copies",
+             "value": float(s["cow_copies"])},
+            {"type": "GAUGE", "key": "jaxserver_pool_stalls",
+             "value": float(s["pool_stalls"])},
+            {"type": "GAUGE", "key": "jaxserver_preemptions",
+             "value": float(s["preemptions"])},
         ]
 
     def tags(self) -> Dict:

@@ -1,6 +1,10 @@
 """Measure achievable HBM bandwidth on this chip: sum-reduce (pure read)
-and scaled copy (read+write) over large arrays, bf16 and int8."""
+and scaled copy (read+write) over large arrays, bf16 and int8 — plus,
+with PROBE_PAGED=1, a paged-KV pool utilization report (blocks
+live/free/shared, CoW copies, internal fragmentation) from a tiny engine
+held mid-decode on a mixed short/long stream set."""
 
+import os
 import time
 
 import jax
@@ -38,7 +42,83 @@ def mm_int8w(a, w):
     return jnp.einsum("bd,df->bf", a, w.astype(a.dtype))
 
 
+def paged_pool_report():
+    """Paged-KV pool utilization under a mixed-length workload: admit a
+    short/long stream set into a tiny paged engine (prefix cache on, one
+    repeated prompt for zero-copy sharing), step it mid-decode by hand,
+    and report the allocator gauges plus internal fragmentation — the
+    fraction of allocated block tokens no stream has written yet (the
+    cost of kv_block granularity; the dense slab's equivalent number is
+    1 - written/max_seq_len per slot)."""
+    import dataclasses
+
+    from seldon_tpu.models import init_params
+    from seldon_tpu.models.config import get_config
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("tiny")
+    eng = InferenceEngine(
+        init_params(cfg, jax.random.key(0)), cfg,
+        EngineConfig(max_slots=4, max_seq_len=64, prompt_buckets=(16, 32),
+                     paged_kv=True, kv_block=16, prefix_cache=True,
+                     prefix_block=8),
+    )
+    def step():
+        with eng._book:
+            work = eng._dispatch_once()
+        if work is not None:
+            eng._process_boundary(*work)
+
+    # 26 tokens -> 3 trie spans (24): the warm stream below matches 24,
+    # sharing 1 full kv block zero-copy + 1 partial block via CoW.
+    shared = list(range(2, 28))
+    sp = SamplingParams(temperature=0.0, max_new_tokens=30)
+    eng.submit(shared, sp)
+    eng.submit(list(range(40, 45)), sp)
+    step()  # cold wave admitted; prompts inserted into the block trie
+    step()
+    # Warm stream AFTER the donor's insertion: its admission refcounts
+    # the shared prompt's blocks (zero-copy) and CoWs the partial tail.
+    eng.submit(shared + [30, 31], sp)
+    for _ in range(2):
+        step()
+    snap = eng.stats.snapshot()
+    bs = eng._kv_block
+    owned = written = 0
+    for req in eng._slots:
+        if req is None or req.finished:
+            continue
+        owned += len(req.block_ids) * bs
+        written += len(req.tokens) + req.n_generated
+    frag = 1.0 - written / owned if owned else 0.0
+    print(
+        f"paged pool [kv_block={bs}]: "
+        f"{snap['pool_blocks_used']}/{snap['pool_blocks_total']} blocks "
+        f"live ({snap['pool_blocks_free']} free, "
+        f"{snap['pool_blocks_shared']} shared)",
+        flush=True,
+    )
+    print(
+        f"  zero-copy admissions: {snap['zero_copy_admissions']}  "
+        f"cow copies: {snap['cow_copies']}  "
+        f"pool stalls: {snap['pool_stalls']}  "
+        f"preemptions: {snap['preemptions']}",
+        flush=True,
+    )
+    print(
+        f"  internal fragmentation: {frag:.1%} "
+        f"({owned - written}/{owned} allocated block tokens unwritten; "
+        f"dense slab would idle "
+        f"{1.0 - written / (3 * 64):.1%} of 3 slots x 64 tokens)",
+        flush=True,
+    )
+
+
 def main():
+    if os.environ.get("PROBE_PAGED", "0") == "1":
+        paged_pool_report()
+        return
     x = jnp.ones((N,), jnp.bfloat16)
     dt = timeit(red_bf16, x)
     print(f"read bf16  2GiB: {dt*1000:7.2f} ms  {2/dt:7.1f} GB/s", flush=True)

@@ -16,6 +16,12 @@ For each slot count: compile the decode step, report (1) per-step wall
 via slope timing, (2) the compiled HLO's peak memory + largest
 allocations, (3) per-step cost SPLIT into attention-only vs MLP-only
 variants to localize the superlinearity.
+
+PROBE_PAGED=1 adds a paged-mode sweep at each slot count with the pool
+sized to the SAME token budget as the dense slab, so the concurrent-
+streams-vs-pool-size cliff is directly comparable: the paged step adds
+the block-table gather on the KV read path, and this probe prices it
+against the slab at every batch size.
 """
 
 from __future__ import annotations
@@ -33,36 +39,59 @@ from tools.timing import slope_time
 PROMPT, NEW = 128, 128
 
 
-def probe(params, cfg, slots: int) -> None:
+def probe(params, cfg, slots: int, paged: bool = False) -> None:
+    # Window padded to the kv_block grid under paged mode; the pool gets
+    # the dense slab's exact token budget so the sweep compares layouts,
+    # not HBM sizes.
+    seq = PROMPT + NEW + 1
+    pkw = {}
+    if paged:
+        seq = (seq + 15) & ~15
+        pkw = dict(paged_kv=True, kv_block=16)
     ecfg = EngineConfig(
         max_slots=slots,
-        max_seq_len=PROMPT + NEW + 1,
+        max_seq_len=seq,
         prompt_buckets=(PROMPT,),
         max_admit=8,
         decode_chunk=1,  # single steps: isolate per-step cost
         min_chunk=1,  # keep the single-step rung valid (min <= decode)
+        **pkw,
     )
     eng = InferenceEngine(params, cfg, ecfg)
     eng.warmup()
-    chunk1 = eng._jit_chunks[1]  # decode_chunk=1 -> single-step rung
+    if paged:
+        chunk1 = eng._jit_chunks_paged[1]
+        import jax.numpy as jnp
 
-    def step(state):
-        s2, _, _, _ = chunk1(params, state)
-        return s2
+        table = jnp.asarray(eng._table_host)
+
+        def step(state):
+            s2, _, _, _ = chunk1(params, state, table)
+            return s2
+    else:
+        chunk1 = eng._jit_chunks[1]  # decode_chunk=1 -> single-step rung
+
+        def step(state):
+            s2, _, _, _ = chunk1(params, state)
+            return s2
 
     # Slope-fit per-step time (the tunneled host<->device RT swamps
     # per-call timing; chained calls cancel it).
     sec, state = slope_time(step, eng._state)
     peak = args = None
     try:
-        comp = chunk1.lower(params, state).compile()
+        if paged:
+            comp = chunk1.lower(params, state, table).compile()
+        else:
+            comp = chunk1.lower(params, state).compile()
         mem = comp.memory_analysis()
         peak = getattr(mem, "temp_size_in_bytes", None)
         args = getattr(mem, "argument_size_in_bytes", None)
     except Exception:  # memory_analysis availability varies per backend
         pass
+    mode = "paged" if paged else "dense"
     print(
-        f"slots={slots:4d}  {sec*1e3:7.2f} ms/step  "
+        f"slots={slots:4d} [{mode}]  {sec*1e3:7.2f} ms/step  "
         f"temp={peak/1e9 if peak else float('nan'):6.2f} GB  "
         f"args={args/1e9 if args else float('nan'):6.2f} GB",
         flush=True,
@@ -78,8 +107,11 @@ def main() -> None:
     params = init_params_int8(cfg, jax.random.key(0))
     dev = jax.devices()[0]
     print(f"device: {dev}", flush=True)
+    paged_too = os.environ.get("PROBE_PAGED", "0") == "1"
     for s in slots_list:
         probe(params, cfg, s)
+        if paged_too:
+            probe(params, cfg, s, paged=True)
 
 
 if __name__ == "__main__":
