@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 bench bench-orchestrator native native-tsan ci fuzz-alloc
+.PHONY: proto test test-e2e tier1 bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -32,6 +32,15 @@ tier1:
 fuzz-alloc:
 	env JAX_PLATFORMS=cpu FUZZ_EXAMPLES=20000 \
 	  python -m pytest tests/test_paged_kv.py -q -m fuzz
+
+# Long-haul chaos soak of the request lifecycle (deadlines, cancels,
+# injected dispatch/alloc faults, drain). Seeded: CHAOS_SEED replays a
+# failing fault sequence byte-for-byte; FUZZ_EXAMPLES scales the number
+# of requests per soak. tier-1 runs only the fast deterministic chaos
+# tests (the soak here is marked slow).
+fuzz-chaos:
+	env JAX_PLATFORMS=cpu FUZZ_EXAMPLES=1000 CHAOS_SEED=$${CHAOS_SEED:-0} \
+	  python -m pytest tests/test_chaos.py -q -m fuzz
 
 bench:
 	python bench.py

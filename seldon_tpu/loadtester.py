@@ -26,11 +26,13 @@ import numpy as np
 
 
 async def _closed_loop(url_path: str, body: bytes, clients: int,
-                       seconds: float, on_response=None):
+                       seconds: float, on_response=None, on_reject=None):
     """Shared closed-loop HTTP driver: N workers hammer one endpoint
     until the deadline. `on_response` (async, gets the aiohttp response)
     does transport-specific accounting; non-200s and exceptions count
-    as errors and are excluded from latency."""
+    as errors and are excluded from latency. `on_reject(status)` lets a
+    transport classify non-200s (429 shed vs 503 draining vs real
+    failure) instead of lumping them into one error count."""
     import aiohttp
 
     stop_at = time.perf_counter() + seconds
@@ -51,6 +53,8 @@ async def _closed_loop(url_path: str, body: bytes, clients: int,
                     if r.status != 200:
                         await r.read()
                         errors[0] += 1
+                        if on_reject is not None:
+                            on_reject(r.status)
                         continue
                     if on_response is not None:
                         # t0 lets transports time INSIDE the response
@@ -139,6 +143,10 @@ def parse_decode_len_dist(spec: str) -> Optional[tuple]:
     return (a, b)
 
 
+class _StreamAborted(Exception):
+    """Stream ended in a non-completed outcome (already accounted)."""
+
+
 async def run_generate(url: str, clients: int, seconds: float,
                        prompt: str = "benchmark prompt",
                        max_new_tokens: int = 32,
@@ -146,7 +154,9 @@ async def run_generate(url: str, clients: int, seconds: float,
                        shared_prefix_frac: float = 0.0,
                        shared_prefix: str = "",
                        stream: bool = True,
-                       decode_len_dist: str = ""):
+                       decode_len_dist: str = "",
+                       cancel_frac: float = 0.0,
+                       deadline_ms: int = 0):
     """LLM serving load: closed-loop generation clients. Latency is full
     completion time; tokens/s is the serving-throughput number. Greedy
     by default so completion lengths — and therefore tokens/s — are
@@ -168,27 +178,62 @@ async def run_generate(url: str, clients: int, seconds: float,
     decode_len_dist (e.g. "uniform:8,256") draws a fresh max_new_tokens
     per request — the short/long decode mix that exposes paged-KV pool
     churn and fragmentation (a fixed length never stresses the
-    allocator's reuse path)."""
+    allocator's reuse path).
+
+    Lifecycle injection: cancel_frac > 0 makes that fraction of
+    streaming clients drop the connection after the first chunk (what a
+    vanished browser does — the engine should cancel, not decode to
+    max_tokens); deadline_ms > 0 stamps a per-request TTL on every
+    request. Every request lands in exactly one `outcomes` bucket
+    ({completed, shed, draining, deadline, cancelled, error}); `errors`
+    stays the legacy everything-not-completed total."""
     dist = parse_decode_len_dist(decode_len_dist)
     len_rng = np.random.default_rng(1)
+    cancel_rng = np.random.default_rng(2)
     tokens = [0]
     ttfts: List[float] = []
     itls: List[float] = []
+    outcomes = {"completed": 0, "shed": 0, "draining": 0,
+                "deadline": 0, "cancelled": 0, "error": 0}
+
+    def on_reject(status: int) -> None:
+        # Pre-stream lifecycle statuses (engine.KIND_HTTP_STATUS): a TTL
+        # that lapses while queued is a 504, not a trailer.
+        if status == 429:
+            outcomes["shed"] += 1
+        elif status == 503:
+            outcomes["draining"] += 1
+        elif status == 504:
+            outcomes["deadline"] += 1
+        elif status == 499:
+            outcomes["cancelled"] += 1
+        else:
+            outcomes["error"] += 1
 
     async def count_tokens(r, t0):
         out = await r.json()
         tokens[0] += int(out.get("completion_tokens", 0))
+        outcomes["completed"] += 1
 
     async def consume_stream(r, t0):
         last = None
         n_total = 0
+        want_cancel = cancel_frac > 0.0 and (
+            cancel_rng.random() < cancel_frac
+        )
         async for line in r.content:
             if not line.strip():
                 continue
             now = time.perf_counter()
             out = json.loads(line)
             if "error" in out:
-                raise RuntimeError(out["error"])
+                # In-band trailer (headers already went out 200): the
+                # `kind` field says how the request actually ended.
+                kind = out.get("kind", "")
+                outcomes[
+                    kind if kind in ("deadline", "cancelled") else "error"
+                ] += 1
+                raise _StreamAborted(out["error"])
             n_toks = len(out.get("token_ids", ()))
             if last is None:
                 ttfts.append(now - t0)
@@ -198,16 +243,29 @@ async def run_generate(url: str, clients: int, seconds: float,
                 itls.extend([(now - last) / n_toks] * n_toks)
             last = now
             n_total = int(out.get("completion_tokens", n_total))
+            if want_cancel:
+                # Simulated client disconnect mid-stream: hard-close the
+                # connection and walk away (no graceful shutdown).
+                outcomes["cancelled"] += 1
+                r.close()
+                raise _StreamAborted("client cancelled")
         tokens[0] += n_total
+        outcomes["completed"] += 1
 
     def payload(p: str) -> bytes:
         mnt = max_new_tokens if dist is None else int(
             len_rng.integers(dist[0], dist[1] + 1)
         )
-        return json.dumps({
+        d = {
             "prompt": p, "max_new_tokens": mnt,
             "temperature": temperature,
-        }).encode()
+        }
+        if deadline_ms > 0:
+            # The REST edge parses this into a proto GenerateRequest,
+            # which has no deadline field — the TTL rides meta.tags
+            # (see seldon_methods._generate_request_dict).
+            d["meta"] = {"tags": {"deadline_ms": deadline_ms}}
+        return json.dumps(d).encode()
 
     if shared_prefix_frac > 0.0:
         # Long enough to span several prefix-cache blocks under the byte
@@ -232,6 +290,7 @@ async def run_generate(url: str, clients: int, seconds: float,
     total, dt, lats, errors = await _closed_loop(
         url.rstrip("/") + path, body, clients, seconds,
         on_response=consume_stream if stream else count_tokens,
+        on_reject=on_reject,
     )
     stream_stats = {}
     if stream:
@@ -241,7 +300,7 @@ async def run_generate(url: str, clients: int, seconds: float,
                 stream_stats[f"{name}_p{q}_ms"] = round(
                     float(np.percentile(arr, q)), 2
                 )
-    return total, dt, lats, errors, tokens[0], stream_stats
+    return total, dt, lats, errors, tokens[0], stream_stats, outcomes
 
 
 def report(transport: str, total: int, dt: float, latencies, errors: int,
@@ -297,18 +356,30 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "/generate endpoint instead of streaming "
                              "/generate_stream (drops TTFT/ITL "
                              "percentiles from the summary)")
+    parser.add_argument("--cancel-frac", type=float, default=0.0,
+                        help="--transport generate: fraction of streaming "
+                             "clients that drop the connection after the "
+                             "first chunk (mid-stream disconnect "
+                             "injection); 0 disables")
+    parser.add_argument("--deadline-ms", type=int, default=0,
+                        help="--transport generate: per-request TTL in "
+                             "ms stamped on every request (deadline "
+                             "injection); 0 disables")
     args = parser.parse_args(argv)
 
     if args.transport == "generate":
-        total, dt, lats, errors, toks, stream_stats = asyncio.run(
+        total, dt, lats, errors, toks, stream_stats, outcomes = asyncio.run(
             run_generate(args.url, args.clients, args.seconds,
                          args.prompt, args.max_new_tokens,
                          args.temperature, args.shared_prefix_frac,
                          args.shared_prefix, stream=not args.no_stream,
-                         decode_len_dist=args.decode_len_dist)
+                         decode_len_dist=args.decode_len_dist,
+                         cancel_frac=args.cancel_frac,
+                         deadline_ms=args.deadline_ms)
         )
         extra = {"completion_tokens": toks,
                  "tokens_per_s": round(toks / dt, 1) if dt else 0.0,
+                 "outcomes": outcomes,
                  **stream_stats}
         if args.shared_prefix_frac > 0.0:
             extra["shared_prefix_frac"] = args.shared_prefix_frac

@@ -23,6 +23,11 @@ class SamplingParams:
     top_p: float = 1.0  # 1.0 = disabled
     max_new_tokens: int = 128
     seed: int = 0
+    # Request TTL in milliseconds, measured from submit. 0 = no per-
+    # request deadline (EngineConfig.default_deadline_ms still applies).
+    # Expired requests are shed from the queue or finalized early at the
+    # next scheduler boundary (servers/engine.py request lifecycle).
+    deadline_ms: int = 0
 
 
 def _mask_top_k_top_p(

@@ -187,8 +187,21 @@ def generate_stream(user_obj: Any, request: pb.GenerateRequest):
     if fn is None or not callable(fn):
         raise um.SeldonNotImplementedError()
     req = _generate_request_dict(request)
-    for out in fn(req):
-        yield _generate_response(request, out)
+    it = fn(req)
+    try:
+        for out in it:
+            if out is None:
+                # Heartbeat from the model's generator (a disconnect poll
+                # point between token bursts): forward it so the transport
+                # can notice a vanished client; never serialized.
+                yield None
+                continue
+            yield _generate_response(request, out)
+    finally:
+        # Explicit close so a transport abandoning THIS generator (client
+        # disconnect) deterministically reaches the model's cleanup (which
+        # cancels the engine request) — not whenever GC gets around to it.
+        it.close()
 
 
 def generate(user_obj: Any, request: pb.GenerateRequest) -> pb.GenerateResponse:
@@ -201,7 +214,7 @@ def generate(user_obj: Any, request: pb.GenerateRequest) -> pb.GenerateResponse:
 
 
 def _generate_request_dict(request: pb.GenerateRequest) -> dict:
-    return {
+    d = {
         "prompt": request.prompt,
         "prompt_token_ids": list(request.prompt_token_ids),
         "max_new_tokens": request.max_new_tokens or 16,
@@ -211,6 +224,18 @@ def _generate_request_dict(request: pb.GenerateRequest) -> dict:
         "seed": request.seed,
         "stop_token_ids": list(request.stop_token_ids),
     }
+    # Per-request deadline rides Meta.tags (GenerateRequest has no
+    # dedicated field; tags is the request's free-form Value map). Accepts
+    # number_value or a numeric string_value.
+    if "deadline_ms" in request.meta.tags:
+        v = request.meta.tags["deadline_ms"]
+        try:
+            d["deadline_ms"] = int(
+                v.number_value or float(v.string_value or 0)
+            )
+        except ValueError:
+            pass
+    return d
 
 
 def _generate_response(request: pb.GenerateRequest, out: dict) -> pb.GenerateResponse:

@@ -22,6 +22,7 @@ import concurrent.futures
 import contextvars
 import json
 import logging
+import threading
 import time
 from typing import Any, Optional
 
@@ -182,10 +183,17 @@ def build_rest_app(
                 request.app["executor"], seldon_methods.generate, request.app["user_obj"], msg
             )
         except Exception as e:
-            logger.exception("generate failed")
-            return web.json_response(
-                SeldonMicroserviceException(str(e), 500).to_dict(), status=500
-            )
+            # Lifecycle errors carry their own HTTP status (duck-typed so
+            # this module never imports the engine): 429 overloaded, 503
+            # draining/preempted, 504 deadline, 499 client cancel.
+            # Anything else is a real 500.
+            status = int(getattr(e, "http_status", 500))
+            if status >= 500 and status not in (503, 504):
+                logger.exception("generate failed")
+            body = SeldonMicroserviceException(str(e), status).to_dict()
+            if getattr(e, "retriable", False):
+                body["status"]["retriable"] = True
+            return web.json_response(body, status=status)
         request.app["metrics"].observe("generate", "rest", time.perf_counter() - t0, None)
         await loop.run_in_executor(
             request.app["executor"], _absorb_user_metrics,
@@ -214,15 +222,25 @@ def build_rest_app(
         t0 = time.perf_counter()
         q: asyncio.Queue = asyncio.Queue()
         done = object()
+        stop = threading.Event()
 
         def pump():
             # The user's generate_stream is a sync generator: drain it on
             # the executor thread, handing each chunk to the event loop.
+            # `None` chunks are heartbeats the model emits between token
+            # bursts — forwarded so the loop side gets a poll point even
+            # when no tokens are flowing. Closing the generator (stop set
+            # by a client disconnect) raises GeneratorExit inside the
+            # model, whose cleanup cancels the engine request.
+            it = None
             try:
                 try:
-                    for chunk in seldon_methods.generate_stream(
+                    it = seldon_methods.generate_stream(
                         request.app["user_obj"], msg
-                    ):
+                    )
+                    for chunk in it:
+                        if stop.is_set():
+                            break
                         loop.call_soon_threadsafe(q.put_nowait, chunk)
                 except SeldonNotImplementedError:
                     # No streaming hook: single-chunk stream around
@@ -235,45 +253,82 @@ def build_rest_app(
                     )
                 loop.call_soon_threadsafe(q.put_nowait, done)
             except Exception as e:
-                logger.exception("generate-stream failed")
+                # Lifecycle outcomes (429/503/504/499) are expected
+                # traffic, not faults — only true 500s get a traceback.
+                status = int(getattr(e, "http_status", 500))
+                if status >= 500 and status not in (503, 504):
+                    logger.exception("generate-stream failed")
                 loop.call_soon_threadsafe(q.put_nowait, e)
+            finally:
+                if it is not None:
+                    try:
+                        it.close()
+                    except Exception:
+                        logger.exception("generate-stream close failed")
 
         fut = loop.run_in_executor(request.app["executor"], pump)
         resp = web.StreamResponse(
             status=200, headers={"Content-Type": "application/x-ndjson"}
         )
         prepared = False
+        client_gone = False
         try:
             while True:
                 item = await q.get()
                 if item is done:
                     break
+                if item is None:
+                    # Heartbeat: check client liveness without writing.
+                    tr = request.transport
+                    if tr is None or tr.is_closing():
+                        client_gone = True
+                        break
+                    continue
                 if isinstance(item, Exception):
+                    status = int(getattr(item, "http_status", 500))
                     if not prepared:
-                        return web.json_response(
-                            SeldonMicroserviceException(
-                                str(item), 500
-                            ).to_dict(),
-                            status=500,
-                        )
+                        body = SeldonMicroserviceException(
+                            str(item), status
+                        ).to_dict()
+                        if getattr(item, "retriable", False):
+                            body["status"]["retriable"] = True
+                        return web.json_response(body, status=status)
                     # Headers already went out 200; the error is an
                     # in-band trailer line, then the stream ends.
                     await resp.write(
-                        json.dumps({"error": str(item)}).encode() + b"\n"
+                        json.dumps({
+                            "error": str(item),
+                            "kind": getattr(item, "kind", "internal"),
+                            "retriable": bool(
+                                getattr(item, "retriable", False)
+                            ),
+                        }).encode() + b"\n"
                     )
                     break
                 if not prepared:
                     await resp.prepare(request)
                     prepared = True
-                await resp.write(
-                    json.dumps(
-                        payloads.message_to_dict(item)
-                    ).encode() + b"\n"
-                )
-            if not prepared:
+                try:
+                    await resp.write(
+                        json.dumps(
+                            payloads.message_to_dict(item)
+                        ).encode() + b"\n"
+                    )
+                except (ConnectionError, ConnectionResetError):
+                    client_gone = True
+                    break
+            if not prepared and not client_gone:
                 await resp.prepare(request)
-            await resp.write_eof()
+            if not client_gone:
+                await resp.write_eof()
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler when the peer drops: tell the
+            # pump to stop (its finally closes the model generator, which
+            # cancels the engine request) and let cancellation propagate.
+            stop.set()
+            raise
         finally:
+            stop.set()
             await fut
         request.app["metrics"].observe(
             "generate-stream", "rest", time.perf_counter() - t0, None
@@ -354,8 +409,18 @@ class _UnitServicer:
             with self._tracer.span(f"unit.{name}", parent=parent):
                 resp = fn(self._user, request)
         except Exception as e:  # pragma: no cover - error path
-            logger.exception("grpc %s failed", name)
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            code = {
+                429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+                503: grpc.StatusCode.UNAVAILABLE,
+                504: grpc.StatusCode.DEADLINE_EXCEEDED,
+                499: grpc.StatusCode.CANCELLED,
+            }.get(
+                int(getattr(e, "http_status", 500)),
+                grpc.StatusCode.INTERNAL,
+            )
+            if code is grpc.StatusCode.INTERNAL:
+                logger.exception("grpc %s failed", name)
+            context.abort(code, str(e))
             return None
         self._metrics.observe(name, "grpc", time.perf_counter() - t0, resp)
         if name == "generate":
@@ -389,24 +454,38 @@ class _UnitServicer:
     def GenerateStream(self, request, context):
         """Server-streaming generation: uses the user's `generate_stream`
         iterator hook if present, else degrades to a single-chunk stream
-        around `generate`."""
+        around `generate`. `None` chunks are model heartbeats — consumed
+        here as client-liveness poll points (a cancelled RPC stops the
+        stream and, via generator close, the engine request)."""
         t0 = time.perf_counter()
+        it = seldon_methods.generate_stream(self._user, request)
         try:
-            it = seldon_methods.generate_stream(self._user, request)
             try:
-                first = next(it)
-            except StopIteration:
-                first = None
+                for chunk in it:
+                    if context is not None and not context.is_active():
+                        break  # client cancelled; close() below cleans up
+                    if chunk is None:
+                        continue
+                    yield chunk
             except SeldonNotImplementedError:
                 # No streaming hook: single-chunk stream around generate().
-                first, it = seldon_methods.generate(self._user, request), iter(())
-            if first is not None:
-                yield first
-                yield from it
+                yield seldon_methods.generate(self._user, request)
         except Exception as e:  # pragma: no cover - error path
-            logger.exception("grpc generate-stream failed")
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            code = {
+                429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+                503: grpc.StatusCode.UNAVAILABLE,
+                504: grpc.StatusCode.DEADLINE_EXCEEDED,
+                499: grpc.StatusCode.CANCELLED,
+            }.get(
+                int(getattr(e, "http_status", 500)),
+                grpc.StatusCode.INTERNAL,
+            )
+            if code is grpc.StatusCode.INTERNAL:
+                logger.exception("grpc generate-stream failed")
+            context.abort(code, str(e))
             return
+        finally:
+            it.close()
         self._metrics.observe("generate-stream", "grpc", time.perf_counter() - t0, None)
         _absorb_user_metrics(self._metrics, self._user)
 

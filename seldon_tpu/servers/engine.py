@@ -41,8 +41,39 @@ import numpy as np
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
+from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
+
+
+# HTTP status per error-item kind, for errors that surface BEFORE any
+# stream bytes went out (after that they ride the in-band trailer).
+# Transports duck-read `http_status` off the exception, so attaching it
+# where the typed exception is built keeps the wrapper engine-agnostic.
+KIND_HTTP_STATUS = {
+    "capacity": 429,
+    "draining": 503,
+    "shutdown": 503,
+    "preempted": 503,
+    "deadline": 504,  # client-set TTL lapsed — not a server fault
+    "cancelled": 499,  # client closed the connection (nginx convention)
+}
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission queue is full — the request was shed at submit time.
+    Retriable with backoff; transports map it to HTTP 429."""
+
+    http_status = 429
+    retriable = True
+
+
+class EngineDraining(RuntimeError):
+    """The engine is draining or stopped and not admitting new work.
+    Retriable against another replica; transports map it to HTTP 503."""
+
+    http_status = 503
+    retriable = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +134,16 @@ class EngineConfig:
     paged_kv: bool = False
     kv_block: int = 16  # tokens per pool block; power of two
     kv_pool_blocks: int = 0  # pool size incl. trash block; 0 -> dense-equiv
+    # Request-lifecycle hardening (defaults keep the dispatch path
+    # byte-identical): TTL applied to requests that set no
+    # SamplingParams.deadline_ms of their own, a bound on the admission
+    # queue (submit raises EngineOverloaded instead of queueing
+    # unboundedly; 0 = unbounded), and deterministic fault injection
+    # (servers/chaos.py; None also consults ChaosConfig.from_env so the
+    # CHAOS=1 env gate works without plumbing a config through).
+    default_deadline_ms: int = 0
+    max_queue: int = 0
+    chaos: Optional[ChaosConfig] = None
 
     def __post_init__(self):
         def pow2(n: int) -> bool:
@@ -184,6 +225,16 @@ class EngineConfig:
                     f"(1 reserved trash block + 1 usable) or 0 for the "
                     f"dense-equivalent budget"
                 )
+        if self.default_deadline_ms < 0:
+            raise ValueError(
+                f"default_deadline_ms ({self.default_deadline_ms}) must be "
+                f">= 0 (0 disables the default TTL)"
+            )
+        if self.max_queue < 0:
+            raise ValueError(
+                f"max_queue ({self.max_queue}) must be >= 0 (0 leaves the "
+                f"admission queue unbounded)"
+            )
 
 
 @dataclasses.dataclass
@@ -222,6 +273,11 @@ class _Request:
     # latest token burst was emitted (drives the ITL histogram).
     first_dispatch_at: Optional[float] = None
     last_burst_at: Optional[float] = None
+    # Lifecycle: absolute deadline (perf_counter seconds, None = no TTL)
+    # and the cancel flag — set from any thread (a GIL-atomic bool
+    # store), acted on by the scheduler at the next boundary reap.
+    deadline: Optional[float] = None
+    cancelled: bool = False
 
 
 class EngineStats:
@@ -278,6 +334,14 @@ class EngineStats:
         # into snapshot() as pool_blocks_* gauges (zeros when dense, so
         # the prometheus surface is unconditional).
         self.pool_gauges = None
+        # Lifecycle observability: requests shed before admission
+        # (overload rejects, drain, queued deadline/cancel), cancels
+        # honored (queued or in-flight), deadline expiries (queued or
+        # in-flight), and submits bounced off the max_queue bound.
+        self.shed_total = 0
+        self.cancelled_total = 0
+        self.deadline_expired_total = 0
+        self.queue_rejects = 0
 
     def record_itl_locked(self, ms: float) -> None:
         """Caller holds self.lock."""
@@ -354,6 +418,10 @@ class EngineStats:
                     if self.budget_dispatches and self.budget_limit
                     else 0.0
                 ),
+                "shed_total": self.shed_total,
+                "cancelled_total": self.cancelled_total,
+                "deadline_expired_total": self.deadline_expired_total,
+                "queue_rejects": self.queue_rejects,
             }
 
 
@@ -428,11 +496,22 @@ class InferenceEngine:
         self._waiting: Deque[_Request] = collections.deque()
         self._rid = 0
         self._rid_lock = threading.Lock()
+        # rid -> live request, the cancel() routing table (pruned in
+        # _complete; shares _rid_lock — both are submit-path touches).
+        self._requests: Dict[int, _Request] = {}
         self.stats = EngineStats()
         if self._paged:
             self.stats.pool_gauges = self._allocator.snapshot
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Deterministic fault injection (opt-in; ChaosConfig.from_env
+        # lets the CHAOS=1 gate enable it without config plumbing).
+        chaos_cfg = self.ecfg.chaos or ChaosConfig.from_env()
+        self._chaos: Optional[ChaosMonkey] = None
+        if chaos_cfg is not None and chaos_cfg.any_enabled():
+            self._chaos = ChaosMonkey(chaos_cfg)
+            logger.warning("chaos fault injection enabled: %s", chaos_cfg)
 
         # Largest power of two <= min(max_admit, max_slots).
         ma = max(1, min(self.ecfg.max_admit, B))
@@ -597,6 +676,13 @@ class InferenceEngine:
                 )
                 for n in self._chunk_sizes
             }
+        # Lifecycle reaping: one masked write freezes cancelled/expired
+        # rows. Dispatched ONLY when a reap actually removed a slot, so
+        # engines that never see a cancel/deadline keep their dispatch
+        # sequence byte-identical.
+        self._jit_deactivate = jax.jit(
+            self._deactivate_impl, donate_argnums=(0,)
+        )
 
     def _fresh_state(self) -> Dict[str, Any]:
         B, Smax = self.ecfg.max_slots, self.ecfg.max_seq_len
@@ -1100,6 +1186,19 @@ class InferenceEngine:
         return state, toks, valid, active
 
     @staticmethod
+    def _deactivate_impl(state, keep):
+        """Freeze rows where keep=False (cancel/deadline reap): dropping
+        `active` and zeroing `remaining` makes the row indistinguishable
+        from one that just hit EOS — the decode chunk's masking already
+        handles frozen pos, clamped sampler knobs, and (paged) trash-
+        routed garbage writes, so no new device invariants appear."""
+        return {
+            **state,
+            "active": state["active"] & keep,
+            "remaining": jnp.where(keep, state["remaining"], 0),
+        }
+
+    @staticmethod
     def _cow_copy_impl(state, src, dst):
         """Copy-on-write block copy: duplicate pool block `src` into
         `dst` (every cache array — k/v and int8 scales). src/dst are
@@ -1131,10 +1230,47 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {len(tokens)} exceeds max bucket {max_prompt}"
             )
+        if len(tokens) + params.max_new_tokens > self.ecfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(tokens)} + max_new_tokens "
+                f"{params.max_new_tokens} exceeds max_seq_len "
+                f"{self.ecfg.max_seq_len}; the decode would be truncated "
+                f"mid-stream — lower max_new_tokens or shorten the prompt"
+            )
+        if self._paged:
+            need = -(-len(tokens) // self._kv_block)
+            if need > self._num_blocks - 1:
+                raise ValueError(
+                    f"prompt needs {need} kv blocks but the pool holds "
+                    f"{self._num_blocks - 1}; it can never be admitted — "
+                    f"raise kv_pool_blocks or shorten the prompt"
+                )
+        if self._draining.is_set() or self._stop.is_set():
+            raise EngineDraining(
+                "engine is draining; retry against another replica"
+            )
+        if self.ecfg.max_queue and (
+            self._pending.qsize() + len(self._waiting) >= self.ecfg.max_queue
+        ):
+            with self.stats.lock:
+                self.stats.queue_rejects += 1
+                self.stats.shed_total += 1
+            raise EngineOverloaded(
+                f"admission queue full ({self.ecfg.max_queue} requests); "
+                f"retry with backoff"
+            )
+        now = time.perf_counter()
+        req = _Request(0, list(tokens), params, queue.Queue(), now)
+        ttl_ms = params.deadline_ms or self.ecfg.default_deadline_ms
+        if ttl_ms:
+            req.deadline = now + ttl_ms / 1000.0
         with self._rid_lock:
             self._rid += 1
-            rid = self._rid
-        req = _Request(rid, list(tokens), params, queue.Queue(), time.perf_counter())
+            req.rid = self._rid
+            self._requests[req.rid] = req
+        # Transports read the rid off the returned queue to cancel() a
+        # request whose client vanished mid-stream.
+        req.out.rid = req.rid
         with self.stats.lock:
             self.stats.requests += 1
         self._pending.put(req)
@@ -1154,18 +1290,112 @@ class InferenceEngine:
             if item is None:
                 break
             if "error" in item:
-                error = item["error"]
+                error = item
                 continue
             toks.extend(item["tokens"])
             if ttft_ms is None:
                 ttft_ms = item.get("ttft_ms")
         if error is not None:
-            raise RuntimeError(f"generation failed: {error}")
+            exc = RuntimeError(f"generation failed: {error['error']}")
+            # Typed-outcome surface for transports: lifecycle kind plus
+            # whether a retry elsewhere could succeed.
+            exc.kind = error.get("kind", "internal")
+            exc.retriable = bool(error.get("retriable", False))
+            exc.http_status = KIND_HTTP_STATUS.get(exc.kind, 500)
+            raise exc
         return {"token_ids": toks, "ttft_ms": ttft_ms}
+
+    def cancel(self, rid: int) -> bool:
+        """Flag a request for cancellation; the scheduler reaps it at the
+        next boundary (queued -> shed, in-flight -> device row frozen and
+        slot/blocks/trie refs freed). Returns False for unknown or
+        already-finished rids — cancel is then a harmless no-op, which is
+        exactly what a disconnect race wants. Thread-safe."""
+        with self._rid_lock:
+            req = self._requests.get(rid)
+        if req is None or req.finished:
+            return False
+        req.cancelled = True
+        return True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful drain: stop admitting (submit raises EngineDraining),
+        shed everything still queued with a retriable error, and wait up
+        to `timeout` seconds for in-flight requests to finish. Returns
+        True once the engine is quiescent. The scheduler keeps running —
+        call stop() afterwards to halt the threads (stop() drains any
+        leftovers itself)."""
+        self._draining.set()
+        if self._thread is None or not self._thread.is_alive():
+            # No scheduler to shed queued work on our behalf.
+            with self._book:
+                self._shed_queued_locked()
+        deadline = time.perf_counter() + max(0.0, timeout)
+        while time.perf_counter() < deadline:
+            with self._book:
+                idle = (
+                    all(r is None for r in self._slots)
+                    and not self._waiting
+                    and not self._prefilling
+                    and self._pending.empty()
+                )
+            if idle and self._fetch_q.empty():
+                return True
+            time.sleep(0.005)
+        return False
+
+    def debug_lifecycle_check(self) -> Dict[str, Any]:
+        """Leak audit for tests/soaks: with no queued or in-flight work,
+        every entry in the returned dict is a leak — a slot still held, a
+        free-list hole, an armed active row, a dangling registry entry,
+        pool blocks that never came back, or trie nodes pinned by dead
+        handles. Unpinned trie RETENTION is flushed first (it is cache,
+        not a leak). Empty dict == clean."""
+        leaks: Dict[str, Any] = {}
+        with self._book:
+            held = [r.rid for r in self._slots if r is not None]
+            if held:
+                leaks["slots"] = held
+            if len(self._free) + len(held) != self.ecfg.max_slots:
+                leaks["free_list"] = len(self._free)
+            if self._active_host.any():
+                leaks["active_host"] = int(self._active_host.sum())
+            if self._waiting or not self._pending.empty():
+                leaks["queued"] = len(self._waiting) + self._pending.qsize()
+            if self._prefilling:
+                leaks["prefilling"] = [r.rid for r in self._prefilling]
+            with self._rid_lock:
+                if self._requests:
+                    leaks["registry"] = sorted(self._requests)
+            if self._paged:
+                if self._paged_prefix is not None:
+                    self._paged_prefix.flush()
+                    if self._paged_prefix.n_nodes:
+                        leaks["trie_pins"] = self._paged_prefix.n_nodes
+                snap = self._allocator.snapshot()
+                if snap["used"]:
+                    leaks["pool_blocks"] = snap
+            elif self._prefix is not None:
+                self._prefix.flush()
+                if self._prefix.n_nodes:
+                    leaks["trie_pins"] = self._prefix.n_nodes
+        return leaks
+
+    def chaos_counts(self) -> Dict[str, int]:
+        """Injected-fault counters (all zero when chaos is disabled)."""
+        return self._chaos.snapshot() if self._chaos is not None else {
+            "dispatch_faults": 0, "alloc_faults": 0,
+            "slow_boundaries": 0, "disconnects": 0,
+        }
 
     def start(self):
         if self._thread is None:
             self._stop.clear()  # allow stop() -> start() restart
+            self._draining.clear()
             if self._async_fetch:
                 self._fetcher = threading.Thread(
                     target=self._fetch_loop, daemon=True
@@ -1175,6 +1405,7 @@ class InferenceEngine:
             self._thread.start()
 
     def stop(self):
+        self._draining.set()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
@@ -1191,12 +1422,84 @@ class InferenceEngine:
                         break
             self._fetcher.join(timeout=30)
             self._fetcher = None
+        # No waiter may be left hanging: everything still queued or in
+        # flight gets a retriable shutdown error + None sentinel.
+        self._shutdown_sweep()
+
+    def _shed_queued_locked(self) -> None:
+        """Fail every queued (not yet admitted) request with a retriable
+        draining error. Caller holds _book or the scheduler is stopped."""
+        self._drain_pending()
+        while self._waiting:
+            req = self._waiting.popleft()
+            with self.stats.lock:
+                self.stats.shed_total += 1
+            self._fail_req(
+                req, "engine draining: request was not admitted",
+                kind="draining", retriable=True,
+            )
+
+    def _shutdown_sweep(self) -> None:
+        """After the scheduler threads exit: fail everything that never
+        reached a terminal state — queued requests, live slots, mid-
+        prefill requests, and requests alive only inside un-fetched
+        boundary rosters (optimistic recycling moves them out of _slots
+        before their results are read). Idempotent via _fail_req."""
+        live: Dict[int, _Request] = {}
+        while True:
+            try:
+                item = self._fetch_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            admits, _, roster = item
+            for group, _, _, _ in admits:
+                for req in group:
+                    live[req.rid] = req
+            for req in roster or []:
+                if req is not None:
+                    live[req.rid] = req
+        for req in self._slots:
+            if req is not None:
+                live[req.rid] = req
+        for req in self._prefilling:
+            live[req.rid] = req
+        self._drain_pending()
+        while self._waiting:
+            req = self._waiting.popleft()
+            live[req.rid] = req
+        # The registry is authoritative for any straggler the scans above
+        # missed (e.g. recycled out of _slots with its boundary already
+        # fetched but the request failed mid-processing).
+        with self._rid_lock:
+            for rid, req in list(self._requests.items()):
+                live.setdefault(rid, req)
+        n_swept = 0
+        for req in live.values():
+            if req is not None and not req.finished:
+                n_swept += 1
+                with self.stats.lock:
+                    self.stats.shed_total += 1
+                self._fail_req(
+                    req, "engine stopped before the request completed",
+                    kind="shutdown", retriable=True,
+                )
+        self._prefilling.clear()
+        if n_swept:
+            logger.warning("shutdown swept %d unfinished requests", n_swept)
 
     def warmup(self) -> None:
         """Pre-compile every (prompt-bucket x group-size) admission variant
         plus the decode chunk, so live traffic never eats a compile. Not
         thread-safe against the scheduler: call before start() (or while no
         requests are in flight)."""
+        # All-True keep mask: a pure compile of the lifecycle-reap freeze
+        # (identity on every row) so the first real cancel/deadline reap
+        # never eats a compile mid-traffic.
+        self._state = self._jit_deactivate(
+            self._state, jnp.ones((self.ecfg.max_slots,), jnp.bool_)
+        )
         sizes = []
         g = 1
         while g <= self._max_admit:
@@ -1471,8 +1774,7 @@ class InferenceEngine:
                     if slot >= 0 and self._slots[slot] is not req \
                             and slot not in self._free:
                         self._free.append(slot)  # popped but never registered
-                    req.out.put({"error": str(e)})
-                    self._complete(req)
+                    self._fail_req(req, str(e), kind="internal")
         return admits
 
     def _dispatch_admit_group(
@@ -1488,6 +1790,7 @@ class InferenceEngine:
         carries only suffixes (so the jit variant is keyed on
         (Pb, Sb, G) — one compile per prefix bucket, mirroring the
         prompt-bucket discipline)."""
+        self._chaos_dispatch("admit")
         G = len(group)
         Gp = 1
         while Gp < G:
@@ -1648,6 +1951,10 @@ class InferenceEngine:
         Frees can only ARRIVE between this check and the allocation
         (single scheduler thread allocates; the fetcher only releases),
         so a True answer cannot go stale."""
+        if self._chaos is not None and (
+            threading.current_thread() is self._thread
+        ) and self._chaos.steal_alloc():
+            return False  # injected exhaustion: admission stalls/preempts
         if self._allocator.free_count >= n:
             return True
         if self._paged_prefix is not None:
@@ -1691,10 +1998,10 @@ class InferenceEngine:
                 "preempting request %d: kv cache pool exhausted",
                 victim.rid,
             )
-            victim.out.put(
-                {"error": "preempted: kv cache pool exhausted"}
+            self._fail_req(
+                victim, "preempted: kv cache pool exhausted",
+                kind="preempted", retriable=True,
             )
-            self._complete(victim)
 
     def _owned_need(self, req: _Request) -> int:
         """Blocks a one-shot admission must ALLOCATE (vs share): the
@@ -1785,8 +2092,8 @@ class InferenceEngine:
                 continue
             got = self._secure_blocks(need - have, requester=req)
             if got is None:
-                req.out.put({"error": "kv cache pool exhausted"})
-                self._complete(req)
+                self._fail_req(req, "kv cache pool exhausted",
+                               kind="capacity", retriable=True)
                 continue
             for j, bid in enumerate(got):
                 self._table_host[slot, have + j] = bid
@@ -1913,6 +2220,7 @@ class InferenceEngine:
         dispatch the fused chunk kernel. G pads to a power of two by
         replicating the last row (identical slot + data — duplicate
         scatters are well-defined), mirroring _dispatch_admit_group."""
+        self._chaos_dispatch("prefill-chunk")
         group = [r[0] for r in rows]
         Sc, W = rows[0][1], rows[0][2]
         G = len(rows)
@@ -2088,8 +2396,7 @@ class InferenceEngine:
                         [r[0].rid for r in rows],
                     )
                     for req, *_ in rows:
-                        req.out.put({"error": str(e)})
-                        self._complete(req)
+                        self._fail_req(req, str(e), kind="internal")
                 i = j
         if n_chunks:
             with self.stats.lock:
@@ -2176,12 +2483,35 @@ class InferenceEngine:
                 for g in gaps_ms:
                     self.stats.record_itl_locked(g)
 
+    def _chaos_dispatch(self, site: str) -> None:
+        """Dispatch-failure injection point, active ONLY on the scheduler
+        thread — warmup and direct test calls share the dispatch helpers
+        and must neither fault nor consume draws (the seeded fault
+        sequence is defined over scheduler-loop dispatches alone)."""
+        if self._chaos is not None and (
+            threading.current_thread() is self._thread
+        ):
+            self._chaos.on_dispatch(site)
+
+    def _fail_req(self, req: _Request, msg: str, kind: str = "internal",
+                  retriable: bool = False) -> None:
+        """Fail one request with a typed error item (kind in {internal,
+        capacity, preempted, cancelled, deadline, draining, shutdown}),
+        then finalize it — slot/blocks/trie refs freed, None sentinel
+        queued. Idempotent like _complete."""
+        if req.finished:
+            return
+        req.out.put({"error": msg, "kind": kind, "retriable": retriable})
+        self._complete(req)
+
     def _complete(self, req: _Request) -> None:
         """Finish a request (idempotent) and free its slot unless the
         slot has already been recycled to a newer request."""
         if req.finished:
             return
         req.finished = True
+        with self._rid_lock:
+            self._requests.pop(req.rid, None)
         if req.prefix_handle is not None:
             # Unpin the trie path — the slot no longer depends on it, so
             # LRU eviction may reclaim it under budget pressure.
@@ -2222,8 +2552,10 @@ class InferenceEngine:
                     live[req.rid] = req
         for req in live.values():
             if not req.finished:
-                req.out.put({"error": err})
-                self._complete(req)
+                # Engine-wreck failures are retriable: the device state is
+                # rebuilt fresh right below and the request did nothing
+                # wrong.
+                self._fail_req(req, err, kind="internal", retriable=True)
         B = self.ecfg.max_slots
         self._slots = [None] * B
         self._free = list(range(B))
@@ -2256,6 +2588,8 @@ class InferenceEngine:
     def _process_boundary(self, admits, chunk_handles, roster) -> None:
         """Fetch one boundary's device results (one parallel transfer) and
         run host bookkeeping."""
+        if self._chaos is not None:
+            self._chaos.maybe_slow_boundary()
         admit_data, chunk_data = jax.device_get(
             (
                 [(f, d) for _, _, f, d in admits],
@@ -2362,6 +2696,8 @@ class InferenceEngine:
                 return
             admits, chunk_handles, roster = item
             try:
+                if self._chaos is not None:
+                    self._chaos.maybe_slow_boundary()
                 admit_data, chunk_data = jax.device_get(
                     ([(f, d) for _, _, f, d in admits], chunk_handles)
                 )
@@ -2399,6 +2735,7 @@ class InferenceEngine:
         table to cover the chunk's worst-case positions (evicting /
         preempting on exhaustion), then pass the fresh tables alongside
         the donated state."""
+        self._chaos_dispatch("decode")
         if self._paged:
             self._grow_decode_blocks(n)
             return self._jit_chunks_paged[n](
@@ -2406,12 +2743,89 @@ class InferenceEngine:
             )
         return self._jit_chunks[n](self.params, self._state)
 
+    def _reap_lifecycle(self) -> None:
+        """Boundary-time lifecycle pass (scheduler thread, under _book):
+        chaos disconnects, drain shedding, queued cancel/deadline
+        shedding, then in-flight cancel/deadline finalization. Reaped
+        in-flight rows are frozen device-side by ONE masked write —
+        dispatched only when a reap actually happened, so engines that
+        never see a cancel/deadline/drain keep their dispatch sequence
+        byte-identical. A request already recycled out of _slots is
+        within decode_chunk tokens of its budget and is left to retire
+        naturally (its waiter already has every token it will get)."""
+        if self._chaos is not None:
+            rids = [
+                r.rid for r in self._slots
+                if r is not None and not r.finished
+            ]
+            victim = self._chaos.pick_disconnect(rids)
+            if victim is not None:
+                self.cancel(victim)
+        if self._draining.is_set():
+            self._shed_queued_locked()
+        now = time.perf_counter()
+        self._drain_pending()
+        if self._waiting and any(
+            r.cancelled or (r.deadline is not None and now >= r.deadline)
+            for r in self._waiting
+        ):
+            kept: List[_Request] = []
+            for req in self._waiting:
+                if req.cancelled:
+                    with self.stats.lock:
+                        self.stats.cancelled_total += 1
+                        self.stats.shed_total += 1
+                    self._fail_req(req, "cancelled before admission",
+                                   kind="cancelled")
+                elif req.deadline is not None and now >= req.deadline:
+                    with self.stats.lock:
+                        self.stats.deadline_expired_total += 1
+                        self.stats.shed_total += 1
+                    self._fail_req(
+                        req,
+                        f"deadline exceeded after "
+                        f"{1000.0 * (now - req.submitted_at):.0f} ms in "
+                        f"queue",
+                        kind="deadline",
+                    )
+                else:
+                    kept.append(req)
+            self._waiting = collections.deque(kept)
+        dead: List[int] = []
+        for slot, req in enumerate(self._slots):
+            if req is None or req.finished:
+                continue
+            if req.cancelled:
+                with self.stats.lock:
+                    self.stats.cancelled_total += 1
+                self._fail_req(
+                    req, f"cancelled after {req.n_generated} tokens",
+                    kind="cancelled",
+                )
+                dead.append(slot)
+            elif req.deadline is not None and now >= req.deadline:
+                with self.stats.lock:
+                    self.stats.deadline_expired_total += 1
+                self._fail_req(
+                    req,
+                    f"deadline exceeded after {req.n_generated} tokens",
+                    kind="deadline",
+                )
+                dead.append(slot)
+        if dead:
+            keep = np.ones((self.ecfg.max_slots,), bool)
+            keep[dead] = False
+            self._state = self._jit_deactivate(
+                self._state, jnp.asarray(keep)
+            )
+
     def _dispatch_once(self):
         """One scheduling step under the bookkeeping lock. Returns the
         (admits, chunk_handles, roster) boundary or None if idle. On an
         exception, self._dispatch_wreck holds the partial boundary so
         the error path can fail recycled-out-of-_slots requests."""
         self._dispatch_wreck = None
+        self._reap_lifecycle()
         admits = (
             self._dispatch_prefill_chunks() if self._chunked
             else self._dispatch_admits()
@@ -2471,6 +2885,7 @@ class InferenceEngine:
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path
             try:
+                self._reap_lifecycle()
                 admits = (
                     self._dispatch_prefill_chunks() if self._chunked
                     else self._dispatch_admits()

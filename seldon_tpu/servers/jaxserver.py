@@ -21,6 +21,7 @@ and contract tester all drive it like any other unit.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional
@@ -31,7 +32,11 @@ from seldon_tpu.core import tracing
 from seldon_tpu.models.config import ModelConfig, get_config
 from seldon_tpu.models.sampling import SamplingParams
 from seldon_tpu.runtime.user_model import SeldonComponent
-from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+from seldon_tpu.servers.engine import (
+    KIND_HTTP_STATUS,
+    EngineConfig,
+    InferenceEngine,
+)
 from seldon_tpu.servers.tokenizer import ByteTokenizer, load_tokenizer
 
 logger = logging.getLogger(__name__)
@@ -59,6 +64,8 @@ class JAXServer(SeldonComponent):
         paged_kv: int = -1,
         kv_block: int = 0,
         kv_pool_mb: int = 0,
+        max_queue: int = 0,
+        default_deadline_ms: int = 0,
     ):
         self.model_uri = model_uri
         self.preset = preset
@@ -120,6 +127,20 @@ class JAXServer(SeldonComponent):
         )
         self.kv_pool_mb = int(
             kv_pool_mb or _os.environ.get("KV_POOL_MB", "0") or 0
+        )
+        # Request-lifecycle hardening (servers/engine.py): bounded
+        # admission queue (submit sheds with 429 EngineOverloaded past
+        # this depth; 0 = unbounded) and a default per-request TTL in ms
+        # (0 = none; per-request deadline_ms still applies). Chaos fault
+        # injection is env-only (CHAOS=1 + CHAOS_* knobs, read by the
+        # engine itself via ChaosConfig.from_env) — never a unit param,
+        # so a deployment manifest can't enable it by accident.
+        self.max_queue = int(
+            max_queue or _os.environ.get("MAX_QUEUE", "0") or 0
+        )
+        self.default_deadline_ms = int(
+            default_deadline_ms
+            or _os.environ.get("DEFAULT_DEADLINE_MS", "0") or 0
         )
         self._loaded = False
         self._load_lock = threading.Lock()
@@ -259,6 +280,10 @@ class JAXServer(SeldonComponent):
                     )
                     blocks = (self.kv_pool_mb << 20) // (per_tok * kb)
                     ekw["kv_pool_blocks"] = max(2, int(blocks))
+            if self.max_queue:
+                ekw["max_queue"] = self.max_queue
+            if self.default_deadline_ms:
+                ekw["default_deadline_ms"] = self.default_deadline_ms
             self.engine = InferenceEngine(
                 params,
                 cfg,
@@ -339,9 +364,21 @@ class JAXServer(SeldonComponent):
         # (including "waiting for slice peers") IS not-ready.
         if not self._loaded:
             raise RuntimeError("model loading (or slice forming)")
+        if self.engine is not None and self.engine.draining:
+            # Readiness flips off the moment drain starts so the load
+            # balancer stops routing here while in-flight work finishes.
+            raise RuntimeError("engine draining")
         if self._slice_ready is not None:
             self._slice_ready.check()  # local accelerator sanity
         return {"engine": self.engine.stats.snapshot()}
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, shed the queue (retriable errors), wait for
+        in-flight requests; readiness goes 503 immediately. Returns True
+        once the engine is quiescent."""
+        if not self._loaded or self.engine is None:
+            return True
+        return self.engine.drain(timeout=timeout)
 
     def init_metadata(self) -> Dict:
         self._ensure_loaded()
@@ -368,6 +405,7 @@ class JAXServer(SeldonComponent):
             top_p=float(get("top_p", 1.0)),
             max_new_tokens=int(get("max_new_tokens", 16) or 16),
             seed=int(get("seed", 0)),
+            deadline_ms=int(get("deadline_ms", 0) or 0),
         )
 
     def _prompt_ids(self, request: Dict) -> List[int]:
@@ -409,26 +447,51 @@ class JAXServer(SeldonComponent):
         ids = self._prompt_ids(request)
         out_q = self.engine.submit(ids, self._to_sampling(request))
         n = 0
-        while True:
-            item = out_q.get()
-            if item is None:
-                break
-            if "error" in item:
-                raise RuntimeError(f"generation failed: {item['error']}")
-            # Tokens arrive in decode-chunk bursts; emit one stream chunk
-            # per burst (EOS stripped).
-            toks = [t for t in item["tokens"] if t != self.cfg.eos_token_id]
-            if not toks:
-                continue
-            n += len(toks)
-            yield {
-                "text": self.tokenizer.decode(toks),
-                "token_ids": toks,
-                "ttft_ms": item.get("ttft_ms", 0.0),
-                "total_ms": 1000.0 * (time.perf_counter() - t0),
-                "prompt_tokens": len(ids),
-                "completion_tokens": n,
-            }
+        done = False
+        try:
+            while True:
+                try:
+                    item = out_q.get(timeout=0.1)
+                except queue.Empty:
+                    # Heartbeat: gives the transport a poll point so a
+                    # vanished client is noticed (and this generator
+                    # closed -> finally -> cancel) even while the engine
+                    # is between token bursts. Transports drop Nones.
+                    yield None
+                    continue
+                if item is None:
+                    done = True
+                    break
+                if "error" in item:
+                    done = True
+                    err = RuntimeError(
+                        f"generation failed: {item['error']}"
+                    )
+                    err.kind = item.get("kind", "internal")
+                    err.retriable = bool(item.get("retriable", False))
+                    err.http_status = KIND_HTTP_STATUS.get(err.kind, 500)
+                    raise err
+                # Tokens arrive in decode-chunk bursts; emit one stream
+                # chunk per burst (EOS stripped).
+                toks = [
+                    t for t in item["tokens"] if t != self.cfg.eos_token_id
+                ]
+                if not toks:
+                    continue
+                n += len(toks)
+                yield {
+                    "text": self.tokenizer.decode(toks),
+                    "token_ids": toks,
+                    "ttft_ms": item.get("ttft_ms", 0.0),
+                    "total_ms": 1000.0 * (time.perf_counter() - t0),
+                    "prompt_tokens": len(ids),
+                    "completion_tokens": n,
+                }
+        finally:
+            if not done:
+                # Closed mid-stream (client disconnect / GeneratorExit):
+                # stop decoding for a reader that's gone.
+                self.engine.cancel(getattr(out_q, "rid", -1))
 
     # --- scoring (MODEL predict parity) -------------------------------------
 
@@ -502,6 +565,14 @@ class JAXServer(SeldonComponent):
              "value": float(s["pool_stalls"])},
             {"type": "GAUGE", "key": "jaxserver_preemptions",
              "value": float(s["preemptions"])},
+            {"type": "GAUGE", "key": "jaxserver_shed_total",
+             "value": float(s["shed_total"])},
+            {"type": "GAUGE", "key": "jaxserver_cancelled_total",
+             "value": float(s["cancelled_total"])},
+            {"type": "GAUGE", "key": "jaxserver_deadline_expired_total",
+             "value": float(s["deadline_expired_total"])},
+            {"type": "GAUGE", "key": "jaxserver_queue_rejects",
+             "value": float(s["queue_rejects"])},
         ]
 
     def tags(self) -> Dict:

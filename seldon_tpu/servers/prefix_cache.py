@@ -179,6 +179,27 @@ class PrefixIndex:
         self.evictions += evicted
         return evicted
 
+    def flush(self) -> int:
+        """Evict every UNPINNED node regardless of the byte budget
+        (drain / leak-audit path — retained KV is cache, so dropping it
+        wholesale is always safe). Nodes left afterwards are pinned by
+        live handles; with no live requests a non-zero n_nodes after
+        flush() is a handle leak. Returns the number dropped."""
+        with self._lock:
+            dropped = 0
+            while True:
+                victims = [nd for nd in self._leaves() if nd.refs == 0]
+                if not victims:
+                    break
+                for nd in victims:
+                    nd.parent.children.pop(nd.key)
+                    self.bytes -= nd.nbytes
+                    self.n_nodes -= 1
+                    nd.arrays = None
+                    dropped += 1
+            self.evictions += dropped
+            return dropped
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return {
@@ -352,6 +373,25 @@ class PagedPrefixIndex:
                 evicted += 1
         self.evictions += evicted
         return evicted
+
+    def flush(self) -> int:
+        """Evict every UNPINNED node, unreffing its pool block (drain /
+        leak-audit path). Nodes left afterwards are pinned by live
+        handles; with no live requests a non-zero n_nodes after flush()
+        is a handle leak. Returns the number dropped."""
+        dropped = 0
+        with self._lock:
+            while True:
+                victims = [nd for nd in self._leaves() if nd.refs == 0]
+                if not victims:
+                    break
+                for nd in victims:
+                    nd.parent.children.pop(nd.key)
+                    self._alloc.unref(nd.block)
+                    self.n_nodes -= 1
+                    dropped += 1
+            self.evictions += dropped
+        return dropped
 
     def clear(self) -> None:
         """Drop every node WITHOUT touching the allocator — only valid

@@ -1,0 +1,423 @@
+"""Request-lifecycle hardening: deadlines, cancellation, load shedding,
+graceful drain (tiny config, CPU mesh).
+
+The load-bearing claims, in test form:
+ * stop() NEVER abandons a waiter — queued and in-flight requests all
+   receive an error item + None sentinel, so generate_blocking callers
+   can't hang across shutdown (the PR-1 regression this PR fixes);
+ * submit() validates what can never succeed (decode past max_seq_len,
+   paged prompts bigger than the whole pool) instead of failing
+   mid-dispatch;
+ * a bounded admission queue sheds with typed EngineOverloaded (429,
+   retriable) and a draining engine refuses with EngineDraining (503);
+ * deadlines expire queued requests without touching the device and
+   finalize in-flight requests at the next boundary;
+ * cancel(rid) frees the slot — and, paged, the pool blocks — within
+   one scheduler boundary: a pool blocked out by a cancelled stream
+   admits the next waiter;
+ * the REST wrapper maps the typed errors onto 429/503 and readiness
+   flips during drain;
+ * after any of the above, engine accounting is leak-free
+   (debug_lifecycle_check() == {}).
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from seldon_tpu.models import init_params
+from seldon_tpu.models.config import get_config
+from seldon_tpu.models.sampling import SamplingParams
+from seldon_tpu.servers.engine import (
+    EngineConfig,
+    EngineDraining,
+    EngineOverloaded,
+    InferenceEngine,
+)
+
+GREEDY = SamplingParams(temperature=0.0, max_new_tokens=8)
+
+
+def _engine(cfg=None, start=True, **ekw):
+    cfg = cfg or get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    ekw.setdefault("max_slots", 4)
+    ekw.setdefault("max_seq_len", 64)
+    ekw.setdefault("prompt_buckets", (8, 32))
+    eng = InferenceEngine(params, cfg, EngineConfig(**ekw))
+    if start:
+        eng.start()
+    return eng
+
+
+def _collect(q, timeout=60):
+    """Drain an output queue to its sentinel: (token_count, error|None)."""
+    toks, err = 0, None
+    while True:
+        item = q.get(timeout=timeout)
+        if item is None:
+            return toks, err
+        if "error" in item:
+            assert err is None, "request produced TWO error items"
+            err = item
+        else:
+            toks += len(item["tokens"])
+
+
+# ---------------------------------------------------------------------------
+# stop(): no waiter left hanging
+# ---------------------------------------------------------------------------
+
+
+def test_stop_fails_queued_requests():
+    """Requests still queued at stop() get a retriable shutdown error +
+    sentinel instead of being silently dropped (pre-hardening, stop()
+    abandoned _pending and generate_blocking callers hung forever)."""
+    eng = _engine(start=False)  # never started: everything stays queued
+    q1 = eng.submit([3, 4, 5], GREEDY)
+    q2 = eng.submit([6, 7], GREEDY)
+    eng.stop()
+    for q_ in (q1, q2):
+        toks, err = _collect(q_, timeout=10)
+        assert toks == 0
+        assert err is not None and err["kind"] == "shutdown"
+        assert err["retriable"] is True
+    assert eng.debug_lifecycle_check() == {}
+
+
+def test_stop_unblocks_generate_blocking():
+    eng = _engine(start=False)
+    box = {}
+
+    def call():
+        try:
+            eng.generate_blocking([3, 4], GREEDY)
+        except RuntimeError as e:
+            box["err"] = e
+
+    t = threading.Thread(target=call, daemon=True)
+    t.start()
+    time.sleep(0.05)  # let it enqueue and block on the out queue
+    eng.stop()
+    t.join(timeout=10)
+    assert not t.is_alive(), "generate_blocking hung across stop()"
+    assert box["err"].kind == "shutdown"
+    assert box["err"].retriable is True
+
+
+# ---------------------------------------------------------------------------
+# submit() validation
+# ---------------------------------------------------------------------------
+
+
+def test_submit_rejects_decode_past_max_seq_len():
+    eng = _engine(start=False, max_seq_len=64)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(list(range(2, 26)),
+                   SamplingParams(temperature=0.0, max_new_tokens=48))
+    eng.stop()
+
+
+def test_submit_rejects_prompt_that_never_fits_pool():
+    """Paged: a prompt needing more blocks than the whole pool holds can
+    never be admitted — reject at submit, not mid-dispatch."""
+    eng = _engine(start=False, max_seq_len=32, prompt_buckets=(16, 32),
+                  paged_kv=True, kv_block=16,
+                  kv_pool_blocks=2)  # trash + 1 usable
+    with pytest.raises(ValueError, match="kv blocks"):
+        eng.submit(list(range(2, 22)),  # 20 tokens -> 2 blocks > 1
+                   SamplingParams(temperature=0.0, max_new_tokens=4))
+    eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bounded admission queue + draining
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_queue_sheds_with_typed_429():
+    eng = _engine(start=False, max_queue=1)
+    q1 = eng.submit([3, 4], GREEDY)
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([5, 6], GREEDY)
+    assert ei.value.http_status == 429
+    assert ei.value.retriable is True
+    snap = eng.stats.snapshot()
+    assert snap["queue_rejects"] == 1
+    assert snap["shed_total"] == 1
+    eng.stop()
+    _, err = _collect(q1, timeout=10)
+    assert err["kind"] == "shutdown"
+
+
+def test_drain_sheds_queued_and_refuses_new():
+    eng = _engine(start=False)
+    q1 = eng.submit([3, 4], GREEDY)
+    assert eng.drain(timeout=5) is True
+    toks, err = _collect(q1, timeout=10)
+    assert toks == 0
+    assert err["kind"] == "draining"
+    assert err["retriable"] is True
+    with pytest.raises(EngineDraining) as ei:
+        eng.submit([5, 6], GREEDY)
+    assert ei.value.http_status == 503
+    eng.stop()
+    assert eng.debug_lifecycle_check() == {}
+
+
+def test_drain_completes_inflight():
+    """drain() lets admitted work finish (only QUEUED work is shed)."""
+    eng = _engine()
+    try:
+        q = eng.submit([3, 4, 5], GREEDY)
+        first = q.get(timeout=60)  # admitted and decoding
+        assert "error" not in first
+        assert eng.drain(timeout=60) is True
+        assert eng.draining
+        toks, err = _collect(q, timeout=60)
+        assert err is None
+        assert len(first["tokens"]) + toks <= GREEDY.max_new_tokens
+        assert eng.debug_lifecycle_check() == {}
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expires_queued_request():
+    """A request whose TTL lapses before admission is shed at the first
+    boundary without ever touching the device."""
+    eng = _engine(start=False)
+    q = eng.submit([3, 4], SamplingParams(
+        temperature=0.0, max_new_tokens=4, deadline_ms=1))
+    time.sleep(0.05)
+    eng.start()
+    try:
+        toks, err = _collect(q, timeout=60)
+        assert toks == 0
+        assert err["kind"] == "deadline"
+        assert eng.stats.snapshot()["deadline_expired_total"] == 1
+    finally:
+        eng.stop()
+
+
+def test_deadline_finalizes_mid_decode():
+    """An in-flight request past its TTL is finalized at the next
+    boundary: tokens already streamed stay streamed, the waiter gets the
+    deadline error, and the slot is reclaimed (engine serves on)."""
+    # decode_chunk=1 (no adaptive ladder) makes boundaries frequent and
+    # the decode long enough that a ~40 ms TTL reliably lapses mid-way.
+    eng = _engine(decode_chunk=1, min_chunk=1, adaptive_chunk=False)
+    try:
+        q = eng.submit([3, 4, 5], SamplingParams(
+            temperature=0.0, max_new_tokens=56, deadline_ms=40))
+        toks, err = _collect(q, timeout=120)
+        assert err is not None and err["kind"] == "deadline"
+        assert toks < 56
+        assert eng.stats.snapshot()["deadline_expired_total"] == 1
+        # The slot came back: a fresh request completes normally.
+        res = eng.generate_blocking([7, 8], GREEDY)
+        assert 1 <= len(res["token_ids"]) <= 8
+        assert eng.debug_lifecycle_check() == {}
+    finally:
+        eng.stop()
+
+
+def test_default_deadline_applies_when_request_sets_none():
+    eng = _engine(start=False, default_deadline_ms=1)
+    q = eng.submit([3, 4], SamplingParams(temperature=0.0, max_new_tokens=4))
+    time.sleep(0.05)
+    eng.start()
+    try:
+        _, err = _collect(q, timeout=60)
+        assert err["kind"] == "deadline"
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_mid_decode_frees_slot():
+    eng = _engine(decode_chunk=1, min_chunk=1, adaptive_chunk=False)
+    try:
+        q = eng.submit([3, 4, 5], SamplingParams(
+            temperature=0.0, max_new_tokens=56))
+        first = q.get(timeout=60)
+        assert "error" not in first
+        assert eng.cancel(q.rid) is True
+        toks, err = _collect(q, timeout=60)
+        assert err["kind"] == "cancelled"
+        assert len(first["tokens"]) + toks < 56
+        assert eng.stats.snapshot()["cancelled_total"] == 1
+        res = eng.generate_blocking([7, 8], GREEDY)
+        assert 1 <= len(res["token_ids"]) <= 8
+        assert eng.debug_lifecycle_check() == {}
+    finally:
+        eng.stop()
+
+
+def test_cancel_unknown_or_finished_rid_is_noop():
+    eng = _engine()
+    try:
+        assert eng.cancel(999999) is False
+        q = eng.submit([3, 4], GREEDY)
+        _collect(q, timeout=60)
+        assert eng.cancel(q.rid) is False  # already finished
+    finally:
+        eng.stop()
+
+
+def test_cancel_releases_blocked_out_pool():
+    """Acceptance: a paged pool fully owned by one stream admits the
+    NEXT waiter within a boundary of cancelling the owner — cancel
+    releases pool blocks, not just the slot."""
+    from seldon_tpu.servers.chaos import ChaosConfig
+
+    # decode_chunk=1 + a 30 ms injected boundary delay pin the owner's
+    # 15-token decode to >=450 ms of wall-clock, so the waiter's stall
+    # and the cancel both demonstrably land while the owner holds the
+    # pool (slow_boundary only sleeps the fetcher; no faults injected).
+    eng = _engine(max_seq_len=32, prompt_buckets=(16, 32),
+                  paged_kv=True, kv_block=16,
+                  kv_pool_blocks=3,  # trash + 2 usable
+                  decode_chunk=1, min_chunk=1, adaptive_chunk=False,
+                  chaos=ChaosConfig(seed=0, slow_boundary=1.0, slow_ms=30))
+    try:
+        # Owner: 17-token prompt -> bucket 32 -> both usable blocks.
+        qa = eng.submit(list(range(2, 19)),
+                        SamplingParams(temperature=0.0, max_new_tokens=15))
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        first = qa.get(timeout=60)
+        assert "error" not in first
+        # Waiter: same shape; stalls on pool exhaustion, not slots.
+        qb = eng.submit(list(range(30, 47)), sp)
+        time.sleep(0.15)  # ~5 of 15 owner tokens elapse
+        assert qb.empty(), "waiter admitted while the pool was full"
+        assert eng.cancel(qa.rid) is True
+        _, err = _collect(qa, timeout=60)
+        assert err["kind"] == "cancelled"
+        toks_b, err_b = _collect(qb, timeout=120)
+        assert err_b is None, f"waiter failed after cancel: {err_b}"
+        assert 1 <= toks_b <= 8
+        assert eng.stats.snapshot()["pool_stalls"] >= 1
+        assert eng.debug_lifecycle_check() == {}
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serving surface: jaxserver + REST wrapper
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    from seldon_tpu.servers.jaxserver import JAXServer
+
+    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=64,
+                    default_deadline_ms=0)
+    srv.load()
+    yield srv
+    srv.engine.stop()
+
+
+def test_jaxserver_deadline_via_request_dict(server):
+    with pytest.raises(RuntimeError, match="deadline"):
+        # TTL already lapsed at the first boundary: kind == deadline.
+        server.generate({"prompt": "hi", "max_new_tokens": 4,
+                         "temperature": 0.0, "deadline_ms": 1})
+
+
+def test_jaxserver_stream_close_cancels_engine_request(server):
+    """Closing the streaming generator mid-stream (what the transports
+    do on client disconnect) cancels the engine request — decode stops
+    well short of max_new_tokens and the slot is freed."""
+    before = server.engine.stats.snapshot()["cancelled_total"]
+    gen = server.generate_stream(
+        {"prompt": "abcd", "max_new_tokens": 48, "temperature": 0.0}
+    )
+    for chunk in gen:
+        if chunk is not None:
+            break  # first real tokens arrived; client "disconnects"
+    gen.close()
+    deadline = time.perf_counter() + 30
+    while time.perf_counter() < deadline:
+        if server.engine.stats.snapshot()["cancelled_total"] == before + 1:
+            break
+        time.sleep(0.01)
+    assert server.engine.stats.snapshot()["cancelled_total"] == before + 1
+    assert server.engine.debug_lifecycle_check() == {}
+
+
+def test_jaxserver_lifecycle_metrics_exposed(server):
+    keys = {m["key"] for m in server.metrics()}
+    assert {"jaxserver_shed_total", "jaxserver_cancelled_total",
+            "jaxserver_deadline_expired_total",
+            "jaxserver_queue_rejects"} <= keys
+
+
+def test_jaxserver_drain_flips_readiness():
+    """Readiness must go 503 the moment drain starts (load balancers
+    stop routing) — on a dedicated server so the module fixture keeps
+    serving."""
+    from seldon_tpu.servers.jaxserver import JAXServer
+
+    srv = JAXServer(preset="tiny", max_slots=2, max_seq_len=64)
+    srv.load()
+    try:
+        assert srv.health_status()["engine"] is not None
+        assert srv.drain(timeout=10) is True
+        with pytest.raises(RuntimeError, match="draining"):
+            srv.health_status()
+    finally:
+        srv.engine.stop()
+
+
+def test_rest_wrapper_maps_429_and_503():
+    """Typed lifecycle errors surface as real HTTP statuses (duck-typed
+    http_status — the wrapper never imports the engine)."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web
+
+    from seldon_tpu.runtime.wrapper import build_rest_app
+
+    class Shedding:
+        def __init__(self, status):
+            self._status = status
+
+        def generate(self, req):
+            e = RuntimeError("no capacity")
+            e.http_status = self._status
+            e.retriable = True
+            raise e
+
+    async def run(status):
+        runner = web.AppRunner(build_rest_app(Shedding(status)))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"http://127.0.0.1:{port}/generate",
+                    json={"prompt": "x"},
+                ) as r:
+                    return r.status, await r.json()
+        finally:
+            await runner.cleanup()
+
+    for status in (429, 503):
+        got, body = asyncio.run(run(status))
+        assert got == status
+        assert body["status"]["retriable"] is True
