@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos
+.PHONY: proto test test-e2e tier1 lint bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -9,6 +9,13 @@ proto:
 
 native:
 	$(MAKE) -C native
+
+# Static invariants (docs/operations.md "Static invariants: graftlint"):
+# hot-sync, lock-guard, retrace, outcome, env-knob vs the checked-in
+# baseline, plus a bytecode-compile sweep of the serving + tools trees.
+lint:
+	python -m tools.graftlint
+	python -m compileall -q seldon_tpu tools
 
 test:
 	python -m pytest tests/ -x -q -m "not e2e"
@@ -48,7 +55,7 @@ bench:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: test test-e2e
+ci: lint test test-e2e
 
 native-tsan:
 	$(MAKE) -C native tsan

@@ -41,8 +41,8 @@ class BlockAllocator:
         self._lock = threading.Lock()
         # LIFO free list: recently-freed blocks are reused first, which
         # keeps the working set of pool pages warm.
-        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._refs: Dict[int, int] = {}
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))  # graftlint: guarded-by(_lock)
+        self._refs: Dict[int, int] = {}  # graftlint: guarded-by(_lock)
 
     # --- lifecycle ----------------------------------------------------------
 

@@ -282,68 +282,72 @@ class _Request:
 
 class EngineStats:
     def __init__(self):
+        # Guards every mutable counter below. The scheduler thread, the
+        # boundary fetcher and submit() all bump counters concurrently;
+        # graftlint's lock-guard pass enforces the `with self.lock:`
+        # discipline tree-wide via the guarded-by annotations.
         self.lock = threading.Lock()
-        self.requests = 0
-        self.completed = 0
-        self.tokens_out = 0
-        self.ttft_sum = 0.0
-        self.ttft_count = 0
+        self.requests = 0  # graftlint: guarded-by(lock) via(stats)
+        self.completed = 0  # graftlint: guarded-by(lock) via(stats)
+        self.tokens_out = 0  # graftlint: guarded-by(lock) via(stats)
+        self.ttft_sum = 0.0  # graftlint: guarded-by(lock) via(stats)
+        self.ttft_count = 0  # graftlint: guarded-by(lock) via(stats)
         # Scheduler observability: decode dispatches and total steps
         # dispatched — their ratio is the effective (adaptive) chunk
         # length, the knob the occupancy policy is turning.
-        self.decode_dispatches = 0
-        self.decode_steps = 0
+        self.decode_dispatches = 0  # graftlint: guarded-by(lock) via(stats)
+        self.decode_steps = 0  # graftlint: guarded-by(lock) via(stats)
         # Prefix-cache observability: admissions that reused cached KV,
         # prompt tokens whose prefill was skipped, and trie nodes evicted
         # under the byte budget.
-        self.prefix_hits = 0
-        self.prefix_tokens_saved = 0
-        self.prefix_evictions = 0
+        self.prefix_hits = 0  # graftlint: guarded-by(lock) via(stats)
+        self.prefix_tokens_saved = 0  # graftlint: guarded-by(lock) via(stats)
+        self.prefix_evictions = 0  # graftlint: guarded-by(lock) via(stats)
         # Admission-queue observability: depth sampled at each dispatch,
         # and submit -> first-dispatch wait per request.
-        self.queue_depth = 0
-        self.queue_wait_sum = 0.0
-        self.queue_wait_count = 0
+        self.queue_depth = 0  # graftlint: guarded-by(lock) via(stats)
+        self.queue_wait_sum = 0.0  # graftlint: guarded-by(lock) via(stats)
+        self.queue_wait_count = 0  # graftlint: guarded-by(lock) via(stats)
         # Inter-token latency histogram (ms, per decode-chunk burst gap).
         # Fixed edges keep the lock hold O(buckets) and make prometheus
         # export trivial; quantiles read the bucket upper edge.
         self.itl_edges_ms = (2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
                              500.0, 1000.0)
-        self.itl_counts = [0] * (len(self.itl_edges_ms) + 1)
-        self.itl_sum_ms = 0.0
+        self.itl_counts = [0] * (len(self.itl_edges_ms) + 1)  # graftlint: guarded-by(lock) via(stats)
+        self.itl_sum_ms = 0.0  # graftlint: guarded-by(lock) via(stats)
         # Chunked-prefill observability: chunks dispatched, prompt tokens
         # they covered, and how full the per-dispatch token budget ran
         # (budget_tokens / (budget_dispatches * budget) = utilization).
-        self.prefill_chunks = 0
-        self.prefill_chunk_tokens = 0
-        self.budget_dispatches = 0
-        self.budget_tokens = 0
-        self.budget_limit = 0
+        self.prefill_chunks = 0  # graftlint: guarded-by(lock) via(stats)
+        self.prefill_chunk_tokens = 0  # graftlint: guarded-by(lock) via(stats)
+        self.budget_dispatches = 0  # graftlint: guarded-by(lock) via(stats)
+        self.budget_tokens = 0  # graftlint: guarded-by(lock) via(stats)
+        self.budget_limit = 0  # graftlint: guarded-by(lock) via(stats)
         # Paged-KV observability: admissions whose warm prefix was shared
         # by refcount alone (no device KV traffic), copy-on-write block
         # copies, admissions stalled on pool exhaustion, streams preempted
         # to free blocks for an active decoder, and — for contrast — warm
         # admissions that DID move prefix KV through the device (dense
         # gather/seed paths; provably zero in paged mode).
-        self.zero_copy_admissions = 0
-        self.cow_copies = 0
-        self.pool_stalls = 0
-        self.preemptions = 0
-        self.prefix_seed_copies = 0
+        self.zero_copy_admissions = 0  # graftlint: guarded-by(lock) via(stats)
+        self.cow_copies = 0  # graftlint: guarded-by(lock) via(stats)
+        self.pool_stalls = 0  # graftlint: guarded-by(lock) via(stats)
+        self.preemptions = 0  # graftlint: guarded-by(lock) via(stats)
+        self.prefix_seed_copies = 0  # graftlint: guarded-by(lock) via(stats)
         # Set by the paged engine to the allocator's snapshot() — merged
         # into snapshot() as pool_blocks_* gauges (zeros when dense, so
         # the prometheus surface is unconditional).
-        self.pool_gauges = None
+        self.pool_gauges = None  # graftlint: guarded-by(lock) via(stats)
         # Lifecycle observability: requests shed before admission
         # (overload rejects, drain, queued deadline/cancel), cancels
         # honored (queued or in-flight), deadline expiries (queued or
         # in-flight), and submits bounced off the max_queue bound.
-        self.shed_total = 0
-        self.cancelled_total = 0
-        self.deadline_expired_total = 0
-        self.queue_rejects = 0
+        self.shed_total = 0  # graftlint: guarded-by(lock) via(stats)
+        self.cancelled_total = 0  # graftlint: guarded-by(lock) via(stats)
+        self.deadline_expired_total = 0  # graftlint: guarded-by(lock) via(stats)
+        self.queue_rejects = 0  # graftlint: guarded-by(lock) via(stats)
 
-    def record_itl_locked(self, ms: float) -> None:
+    def record_itl_locked(self, ms: float) -> None:  # graftlint: holds(lock)
         """Caller holds self.lock."""
         i = 0
         for edge in self.itl_edges_ms:
@@ -353,7 +357,7 @@ class EngineStats:
         self.itl_counts[i] += 1
         self.itl_sum_ms += ms
 
-    def _itl_quantile_locked(self, q: float) -> float:
+    def _itl_quantile_locked(self, q: float) -> float:  # graftlint: holds(lock)
         total = sum(self.itl_counts)
         if not total:
             return 0.0
@@ -368,8 +372,12 @@ class EngineStats:
         return 2.0 * self.itl_edges_ms[-1]
 
     def snapshot(self) -> Dict[str, float]:
+        with self.lock:
+            gauges = self.pool_gauges
+        # Called outside the stats lock: the allocator snapshot takes its
+        # own lock and must stay a leaf in the lock order.
         pool = (
-            self.pool_gauges() if self.pool_gauges is not None
+            gauges() if gauges is not None
             else {"total": 0, "used": 0, "free": 0, "shared": 0}
         )
         with self.lock:
@@ -475,10 +483,10 @@ class InferenceEngine:
                 self.ecfg.kv_pool_blocks or B * self._nbs + 1
             )
             self._allocator = BlockAllocator(self._num_blocks)
-            self._table_host = np.zeros((B, self._nbs), np.int32)
+            self._table_host = np.zeros((B, self._nbs), np.int32)  # graftlint: guarded-by(_book)
 
         self._state = self._fresh_state()
-        self._active_host = np.zeros((B,), bool)  # control-flow mirror
+        self._active_host = np.zeros((B,), bool)  # control-flow mirror  # graftlint: guarded-by(_book)
         # Serializes slot/free-list/active bookkeeping between the
         # scheduler thread and the boundary-fetcher thread.
         self._book = threading.Lock()
@@ -487,18 +495,18 @@ class InferenceEngine:
         )
         self._fetch_q: "queue.Queue" = queue.Queue(maxsize=4)
         self._fetcher: Optional[threading.Thread] = None
-        self._dispatch_wreck = None  # partial boundary for error paths
+        self._dispatch_wreck = None  # partial boundary for error paths  # graftlint: guarded-by(_book)
 
         # Host-side bookkeeping.
-        self._slots: List[Optional[_Request]] = [None] * B
-        self._free: List[int] = list(range(B))
+        self._slots: List[Optional[_Request]] = [None] * B  # graftlint: guarded-by(_book)
+        self._free: List[int] = list(range(B))  # graftlint: guarded-by(_book)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
-        self._waiting: Deque[_Request] = collections.deque()
-        self._rid = 0
+        self._waiting: Deque[_Request] = collections.deque()  # graftlint: guarded-by(_book)
+        self._rid = 0  # graftlint: guarded-by(_rid_lock)
         self._rid_lock = threading.Lock()
         # rid -> live request, the cancel() routing table (pruned in
         # _complete; shares _rid_lock — both are submit-path touches).
-        self._requests: Dict[int, _Request] = {}
+        self._requests: Dict[int, _Request] = {}  # graftlint: guarded-by(_rid_lock)
         self.stats = EngineStats()
         if self._paged:
             self.stats.pool_gauges = self._allocator.snapshot
@@ -588,7 +596,7 @@ class InferenceEngine:
         # and resident-prefix widths reuse the prompt buckets. The chunk
         # kernel is one jit keyed on (G, Sc) + static prefix_width.
         self._chunked = bool(self.ecfg.chunked_prefill)
-        self._prefilling: Deque[_Request] = collections.deque()
+        self._prefilling: Deque[_Request] = collections.deque()  # graftlint: guarded-by(_book)
         self._jit_admit_chunk = None
         self._jit_seed_prefix = None
         self._jit_admit_chunk_paged = None
@@ -1249,9 +1257,12 @@ class InferenceEngine:
             raise EngineDraining(
                 "engine is draining; retry against another replica"
             )
-        if self.ecfg.max_queue and (
-            self._pending.qsize() + len(self._waiting) >= self.ecfg.max_queue
-        ):
+        if self.ecfg.max_queue:
+            # _book makes the depth a coherent snapshot: _waiting is the
+            # scheduler's queue and mutates under the bookkeeping lock.
+            with self._book:
+                depth = self._pending.qsize() + len(self._waiting)
+        if self.ecfg.max_queue and depth >= self.ecfg.max_queue:
             with self.stats.lock:
                 self.stats.queue_rejects += 1
                 self.stats.shed_total += 1
@@ -1392,6 +1403,25 @@ class InferenceEngine:
             "slow_boundaries": 0, "disconnects": 0,
         }
 
+    def slots_busy(self) -> int:
+        """Occupied-slot count, read under the bookkeeping lock. The one
+        sanctioned way for metrics exporters to observe slot occupancy."""
+        with self._book:
+            return sum(1 for r in self._slots if r is not None)
+
+    def live_requests(self) -> List["_Request"]:
+        """Snapshot of the requests currently holding slots, taken under
+        the bookkeeping lock. The list is a copy; the _Request objects are
+        live, so only probe/diagnostic readers should use this."""
+        with self._book:
+            return [r for r in self._slots if r is not None]
+
+    def table_host_snapshot(self) -> np.ndarray:
+        """Copy of the host-side block table under the bookkeeping lock,
+        for probes that replay the decode kernel outside the engine."""
+        with self._book:
+            return self._table_host.copy()
+
     def start(self):
         if self._thread is None:
             self._stop.clear()  # allow stop() -> start() restart
@@ -1426,7 +1456,7 @@ class InferenceEngine:
         # flight gets a retriable shutdown error + None sentinel.
         self._shutdown_sweep()
 
-    def _shed_queued_locked(self) -> None:
+    def _shed_queued_locked(self) -> None:  # graftlint: holds(_book)
         """Fail every queued (not yet admitted) request with a retriable
         draining error. Caller holds _book or the scheduler is stopped."""
         self._drain_pending()
@@ -1445,49 +1475,53 @@ class InferenceEngine:
         prefill requests, and requests alive only inside un-fetched
         boundary rosters (optimistic recycling moves them out of _slots
         before their results are read). Idempotent via _fail_req."""
-        live: Dict[int, _Request] = {}
-        while True:
-            try:
-                item = self._fetch_q.get_nowait()
-            except queue.Empty:
-                break
-            if item is None:
-                continue
-            admits, _, roster = item
-            for group, _, _, _ in admits:
-                for req in group:
-                    live[req.rid] = req
-            for req in roster or []:
+        # The scheduler threads are already joined, so _book is
+        # uncontended here — taking it keeps the holds(_book)
+        # protocol of _drain_pending/_fail_req honest.
+        with self._book:
+            live: Dict[int, _Request] = {}
+            while True:
+                try:
+                    item = self._fetch_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    continue
+                admits, _, roster = item
+                for group, _, _, _ in admits:
+                    for req in group:
+                        live[req.rid] = req
+                for req in roster or []:
+                    if req is not None:
+                        live[req.rid] = req
+            for req in self._slots:
                 if req is not None:
                     live[req.rid] = req
-        for req in self._slots:
-            if req is not None:
+            for req in self._prefilling:
                 live[req.rid] = req
-        for req in self._prefilling:
-            live[req.rid] = req
-        self._drain_pending()
-        while self._waiting:
-            req = self._waiting.popleft()
-            live[req.rid] = req
-        # The registry is authoritative for any straggler the scans above
-        # missed (e.g. recycled out of _slots with its boundary already
-        # fetched but the request failed mid-processing).
-        with self._rid_lock:
-            for rid, req in list(self._requests.items()):
-                live.setdefault(rid, req)
-        n_swept = 0
-        for req in live.values():
-            if req is not None and not req.finished:
-                n_swept += 1
-                with self.stats.lock:
-                    self.stats.shed_total += 1
-                self._fail_req(
-                    req, "engine stopped before the request completed",
-                    kind="shutdown", retriable=True,
-                )
-        self._prefilling.clear()
-        if n_swept:
-            logger.warning("shutdown swept %d unfinished requests", n_swept)
+            self._drain_pending()
+            while self._waiting:
+                req = self._waiting.popleft()
+                live[req.rid] = req
+            # The registry is authoritative for any straggler the scans above
+            # missed (e.g. recycled out of _slots with its boundary already
+            # fetched but the request failed mid-processing).
+            with self._rid_lock:
+                for rid, req in list(self._requests.items()):
+                    live.setdefault(rid, req)
+            n_swept = 0
+            for req in live.values():
+                if req is not None and not req.finished:
+                    n_swept += 1
+                    with self.stats.lock:
+                        self.stats.shed_total += 1
+                    self._fail_req(
+                        req, "engine stopped before the request completed",
+                        kind="shutdown", retriable=True,
+                    )
+            self._prefilling.clear()
+            if n_swept:
+                logger.warning("shutdown swept %d unfinished requests", n_swept)
 
     def warmup(self) -> None:
         """Pre-compile every (prompt-bucket x group-size) admission variant
@@ -1516,7 +1550,7 @@ class InferenceEngine:
                 self._state = self._jit_cow(
                     self._state, jnp.int32(0), jnp.int32(0)
                 )
-            jax.block_until_ready(self._state["last_tok"])
+            jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
             logger.info(
                 "engine warmed: %d prefill-chunk variants + %d decode "
                 "chunk sizes",
@@ -1558,7 +1592,7 @@ class InferenceEngine:
             )
             for n in self._chunk_sizes:
                 self._state, _, _, _ = self._dispatch_decode_chunk(n)
-            jax.block_until_ready(self._state["last_tok"])
+            jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
             logger.info(
                 "engine warmed (paged): %d admission variants + %d decode "
                 "chunk sizes",
@@ -1611,7 +1645,7 @@ class InferenceEngine:
         # chunk-ladder rung.
         for n in self._chunk_sizes:
             self._state, _, _, _ = self._dispatch_decode_chunk(n)
-        jax.block_until_ready(self._state["last_tok"])
+        jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
         logger.info(
             "engine warmed: %d admission variants (+%d prefix-warm) + %d "
             "decode chunk sizes",
@@ -1707,7 +1741,7 @@ class InferenceEngine:
             )
         return self._bucket(len(req.tokens)), 0
 
-    def _drain_pending(self) -> None:
+    def _drain_pending(self) -> None:  # graftlint: holds(_book)
         while True:
             try:
                 self._waiting.append(self._pending.get_nowait())
@@ -1732,7 +1766,7 @@ class InferenceEngine:
                 self.stats.queue_wait_sum += wait
                 self.stats.queue_wait_count += n
 
-    def _dispatch_admits(self) -> List[Tuple[List[_Request], Any, Any, Any]]:
+    def _dispatch_admits(self) -> List[Tuple[List[_Request], Any, Any, Any]]:  # graftlint: holds(_book)
         """Admit FIFO prefix runs of same-bucket waiting requests as batched
         groups. Dispatches device work only — returns un-synced handles."""
         self._drain_pending()
@@ -1777,7 +1811,7 @@ class InferenceEngine:
                     self._fail_req(req, str(e), kind="internal")
         return admits
 
-    def _dispatch_admit_group(
+    def _dispatch_admit_group(  # graftlint: holds(_book)
         self, group: List[_Request], Sb: int, Pb: int = 0
     ) -> Tuple[List[_Request], Any, Any, Any]:
         """Build host arrays for `group`, dispatch the fused admission.
@@ -1964,7 +1998,7 @@ class InferenceEngine:
                     self.stats.prefix_evictions += evicted
         return self._allocator.free_count >= n
 
-    def _secure_blocks(
+    def _secure_blocks(  # graftlint: holds(_book)
         self, n: int, requester: Optional[_Request] = None,
         allow_preempt: bool = True,
     ) -> Optional[List[int]]:
@@ -2013,7 +2047,7 @@ class InferenceEngine:
         shared = (req.prefix_len or 0) // bs
         return total - shared
 
-    def _paged_admit_blocks(self, req: _Request, cows: List[Tuple[int, int]],
+    def _paged_admit_blocks(self, req: _Request, cows: List[Tuple[int, int]],  # graftlint: holds(_book)
                             cover: int) -> None:
         """Fill req's block-table row for prompt positions [0, cover):
         fully matched kv blocks are SHARED by refcount (zero-copy), a
@@ -2053,7 +2087,7 @@ class InferenceEngine:
             bids.append(bid)
         req.block_ids = bids
 
-    def _release_blocks(self, req: _Request) -> None:
+    def _release_blocks(self, req: _Request) -> None:  # graftlint: holds(_book)
         """Drop every allocator ref req's table row holds (idempotent).
         The row is zeroed so in-flight strays land in the trash block;
         actual block REUSE is ordering-safe because a new owner's
@@ -2070,7 +2104,7 @@ class InferenceEngine:
             self._allocator.unref(bid)
         req.block_ids = []
 
-    def _grow_decode_blocks(self, n: int) -> None:
+    def _grow_decode_blocks(self, n: int) -> None:  # graftlint: holds(_book)
         """Before a decode chunk of n steps: extend each active slot's
         block table to cover the chunk's worst-case write positions
         (pos <= plen + expected - 1 by the recycling invariant, so this
@@ -2099,7 +2133,7 @@ class InferenceEngine:
                 self._table_host[slot, have + j] = bid
             req.block_ids.extend(got)
 
-    def _insert_paged_prompt(self, req: _Request, upto: int) -> None:
+    def _insert_paged_prompt(self, req: _Request, upto: int) -> None:  # graftlint: holds(_book)
         """Extend the paged trie over req's prompt blocks [0, upto):
         new nodes record (and ref) the pool block the slot's table maps
         their span to — pure host bookkeeping, no device KV moves."""
@@ -2123,7 +2157,7 @@ class InferenceEngine:
                 return b
         return self._chunk_buckets[-1]
 
-    def _admit_chunk_slot(self, req: _Request) -> None:
+    def _admit_chunk_slot(self, req: _Request) -> None:  # graftlint: holds(_book)
         """Admit a request into a slot for chunked prefill: register it
         immediately (error paths then fail it through _slots), look up
         the prefix cache, and seed any warm hit's trie KV into the slot
@@ -2163,7 +2197,7 @@ class InferenceEngine:
                 with self.stats.lock:
                     self.stats.prefix_seed_copies += 1
 
-    def _collect_chunk_work(
+    def _collect_chunk_work(  # graftlint: holds(_book)
         self, left: int
     ) -> List[Tuple[_Request, int, int, bool, int]]:
         """One budget pass: pop each dispatchable request at most once
@@ -2213,7 +2247,7 @@ class InferenceEngine:
             left -= Sc
         return work
 
-    def _dispatch_chunk_group(
+    def _dispatch_chunk_group(  # graftlint: holds(_book)
         self, rows: List[Tuple[_Request, int, int, bool, int]]
     ) -> Tuple[List[_Request], Any, Any, Any]:
         """Build host arrays for one same-(Sc, W) run of chunk rows and
@@ -2354,7 +2388,7 @@ class InferenceEngine:
                 with self.stats.lock:
                     self.stats.prefix_evictions += evicted
 
-    def _dispatch_prefill_chunks(
+    def _dispatch_prefill_chunks(  # graftlint: holds(_book)
         self,
     ) -> List[Tuple[List[_Request], Any, Any, Any]]:
         """Chunked-prefill admission: pack at most dispatch_token_budget
@@ -2409,7 +2443,7 @@ class InferenceEngine:
 
     # --- boundary processing -----------------------------------------------
 
-    def _process_admits(
+    def _process_admits(  # graftlint: holds(_book)
         self,
         admits: List[Tuple[List[_Request], Any, Any, Any]],
         admit_data: List[Tuple[np.ndarray, np.ndarray]],
@@ -2450,7 +2484,7 @@ class InferenceEngine:
                 self.stats.ttft_count += n_armed
                 self.stats.tokens_out += n_armed
 
-    def _process_chunk(self, toks_h, valid_h, active_h, roster) -> None:
+    def _process_chunk(self, toks_h, valid_h, active_h, roster) -> None:  # graftlint: holds(_book)
         """toks_h [K, B], valid_h [K, B], active_h [B] — host arrays;
         `roster` is the slot->request snapshot taken when THIS chunk was
         dispatched (the live slot table may have moved on: optimistic
@@ -2504,7 +2538,7 @@ class InferenceEngine:
         req.out.put({"error": msg, "kind": kind, "retriable": retriable})
         self._complete(req)
 
-    def _complete(self, req: _Request) -> None:
+    def _complete(self, req: _Request) -> None:  # graftlint: holds(_book)
         """Finish a request (idempotent) and free its slot unless the
         slot has already been recycled to a newer request."""
         if req.finished:
@@ -2531,7 +2565,7 @@ class InferenceEngine:
         with self.stats.lock:
             self.stats.completed += 1
 
-    def _fail_all(self, err: str, pendings=()) -> None:
+    def _fail_all(self, err: str, pendings=()) -> None:  # graftlint: holds(_book)
         """Fail every live request and reset device + slot state — called
         when a dispatched computation errored (donated buffers are gone).
         `pendings`: in-flight (admits, handles, roster) tuples — requests
@@ -2567,7 +2601,8 @@ class InferenceEngine:
             # matches the fresh device state (trie refs included).
             from seldon_tpu.servers.block_pool import BlockAllocator
             self._allocator = BlockAllocator(self._num_blocks)
-            self.stats.pool_gauges = self._allocator.snapshot
+            with self.stats.lock:
+                self.stats.pool_gauges = self._allocator.snapshot
             self._table_host[:] = 0
             if self._paged_prefix is not None:
                 from seldon_tpu.servers.prefix_cache import \
@@ -2585,12 +2620,12 @@ class InferenceEngine:
                 req.block_ids = []
         self._state = self._fresh_state()
 
-    def _process_boundary(self, admits, chunk_handles, roster) -> None:
+    def _process_boundary(self, admits, chunk_handles, roster) -> None:  # graftlint: holds(_book)
         """Fetch one boundary's device results (one parallel transfer) and
         run host bookkeeping."""
         if self._chaos is not None:
             self._chaos.maybe_slow_boundary()
-        admit_data, chunk_data = jax.device_get(
+        admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
             (
                 [(f, d) for _, _, f, d in admits],
                 chunk_handles,
@@ -2600,7 +2635,7 @@ class InferenceEngine:
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
 
-    def _roster(self) -> List[Optional[_Request]]:
+    def _roster(self) -> List[Optional[_Request]]:  # graftlint: holds(_book)
         """Slot -> request snapshot for THIS wave's decode chunk. Mid-
         prefill requests hold slots but have produced no tokens and are
         device-inactive — masking them out keeps _process_chunk from
@@ -2613,7 +2648,7 @@ class InferenceEngine:
             for r in self._slots
         ]
 
-    def _pick_chunk(self) -> int:
+    def _pick_chunk(self) -> int:  # graftlint: holds(_book)
         """Prefill-priority chunk policy: admissions only happen at chunk
         boundaries, so a long chunk is admission LATENCY whenever an
         arrival could actually be admitted. Long chunks are therefore
@@ -2642,7 +2677,7 @@ class InferenceEngine:
             return sizes[min(len(sizes) // 2, len(sizes) - 2)]
         return sizes[0]
 
-    def _recycle_budget_spent(self, roster: List[Optional[_Request]],
+    def _recycle_budget_spent(self, roster: List[Optional[_Request]],  # graftlint: holds(_book)
                               chunk_len: int) -> None:
         """Optimistic slot recycling: `expected` is an upper bound on the
         tokens a row will have produced once every dispatched chunk
@@ -2698,7 +2733,7 @@ class InferenceEngine:
             try:
                 if self._chaos is not None:
                     self._chaos.maybe_slow_boundary()
-                admit_data, chunk_data = jax.device_get(
+                admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
                     ([(f, d) for _, _, f, d in admits], chunk_handles)
                 )
                 with self._book:
@@ -2729,7 +2764,7 @@ class InferenceEngine:
         else:
             self._loop_sync()
 
-    def _dispatch_decode_chunk(self, n: int):
+    def _dispatch_decode_chunk(self, n: int):  # graftlint: holds(_book)
         """Dispatch one n-step decode chunk. Dense engines call the slab
         kernel unchanged; paged engines first grow each live row's block
         table to cover the chunk's worst-case positions (evicting /
@@ -2743,7 +2778,7 @@ class InferenceEngine:
             )
         return self._jit_chunks[n](self.params, self._state)
 
-    def _reap_lifecycle(self) -> None:
+    def _reap_lifecycle(self) -> None:  # graftlint: holds(_book)
         """Boundary-time lifecycle pass (scheduler thread, under _book):
         chaos disconnects, drain shedding, queued cancel/deadline
         shedding, then in-flight cancel/deadline finalization. Reaped
@@ -2819,7 +2854,7 @@ class InferenceEngine:
                 self._state, jnp.asarray(keep)
             )
 
-    def _dispatch_once(self):
+    def _dispatch_once(self):  # graftlint: holds(_book)
         """One scheduling step under the bookkeeping lock. Returns the
         (admits, chunk_handles, roster) boundary or None if idle. On an
         exception, self._dispatch_wreck holds the partial boundary so
@@ -2867,10 +2902,9 @@ class InferenceEngine:
                 logger.exception("engine dispatch failed")
                 # _dispatch_once may have recycled requests out of
                 # _slots before failing; they live only in its roster.
-                self._drain_and_fail(
-                    str(e), current=self._dispatch_wreck
-                )
-                self._dispatch_wreck = None
+                with self._book:
+                    wreck, self._dispatch_wreck = self._dispatch_wreck, None
+                self._drain_and_fail(str(e), current=wreck)
                 continue
             if work is not None:
                 # Bounded queue (maxsize=4): caps how far the host's
@@ -2881,51 +2915,62 @@ class InferenceEngine:
                 time.sleep(self.ecfg.idle_sleep_s)
 
     def _loop_sync(self) -> None:
+        # Slot/free-list/active bookkeeping runs under _book even in the
+        # synchronous (no fetcher thread) mode: drain(), cancel paths and
+        # debug_lifecycle_check() read the same state from other threads.
         pending: Optional[Tuple[list, Any, list]] = None
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path
             try:
-                self._reap_lifecycle()
-                admits = (
-                    self._dispatch_prefill_chunks() if self._chunked
-                    else self._dispatch_admits()
-                )
-                if admits or self._active_host.any():
-                    # Chunk consumes the post-admission state; device-side
-                    # `active` is already armed even though _active_host
-                    # lags until _process_admits.
-                    roster = self._roster()
-                    n = self._pick_chunk()
-                    self._state, toks, valid, active_after = (
-                        self._dispatch_decode_chunk(n)
+                with self._book:
+                    self._reap_lifecycle()
+                    admits = (
+                        self._dispatch_prefill_chunks() if self._chunked
+                        else self._dispatch_admits()
                     )
-                    chunk_handles = (toks, valid, active_after)
-                    with self.stats.lock:
-                        self.stats.decode_dispatches += 1
-                        self.stats.decode_steps += n
-                    self._recycle_budget_spent(roster, n)
-                else:
-                    chunk_handles = None
-                if pending is not None:
-                    self._process_boundary(*pending)
-                pending = (
-                    (admits, chunk_handles, roster)
-                    if (admits or chunk_handles is not None)
-                    else None
-                )
-                if pending is None and not self._active_host.any():
-                    if self._pending.empty():
-                        time.sleep(self.ecfg.idle_sleep_s)
+                    if admits or self._active_host.any():
+                        # Chunk consumes the post-admission state;
+                        # device-side `active` is already armed even
+                        # though _active_host lags until _process_admits.
+                        roster = self._roster()
+                        n = self._pick_chunk()
+                        self._state, toks, valid, active_after = (
+                            self._dispatch_decode_chunk(n)
+                        )
+                        chunk_handles = (toks, valid, active_after)
+                        with self.stats.lock:
+                            self.stats.decode_dispatches += 1
+                            self.stats.decode_steps += n
+                        self._recycle_budget_spent(roster, n)
+                    else:
+                        chunk_handles = None
+                    if pending is not None:
+                        self._process_boundary(*pending)
+                    pending = (
+                        (admits, chunk_handles, roster)
+                        if (admits or chunk_handles is not None)
+                        else None
+                    )
+                    idle = (
+                        pending is None and not self._active_host.any()
+                    )
+                # Sleep outside the lock so drain()/cancel() never wait
+                # on an idle tick.
+                if idle and self._pending.empty():
+                    time.sleep(self.ecfg.idle_sleep_s)
             except Exception as e:  # fail requests, reset, keep serving
                 logger.exception("engine iteration failed")
                 # The CURRENT iteration's admits/roster may hold requests
                 # already recycled out of _slots — fail them too.
-                self._fail_all(str(e), [pending, (admits, None, roster)])
+                with self._book:
+                    self._fail_all(str(e), [pending, (admits, None, roster)])
                 pending = None
         # Drain the in-flight boundary so stop() doesn't strand requests.
         if pending is not None:
             try:
-                self._process_boundary(*pending)
+                with self._book:
+                    self._process_boundary(*pending)
             except Exception as e:
                 logger.exception("final boundary failed")
-                self._fail_all(str(e), [pending])
+                with self._book:
+                    self._fail_all(str(e), [pending])

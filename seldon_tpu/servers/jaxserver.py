@@ -522,9 +522,7 @@ class JAXServer(SeldonComponent):
             {"type": "GAUGE", "key": "jaxserver_completed",
              "value": float(s["completed"])},
             {"type": "GAUGE", "key": "jaxserver_slots_busy",
-             "value": float(sum(
-                 1 for r in self.engine._slots if r is not None
-             ))},
+             "value": float(self.engine.slots_busy())},
             {"type": "GAUGE", "key": "jaxserver_decode_dispatches",
              "value": float(s["decode_dispatches"])},
             {"type": "GAUGE", "key": "jaxserver_decode_steps",

@@ -1,0 +1,380 @@
+"""graftlint: one positive + one negative fixture per pass, baseline
+round-trip, and a tier-1 gate that the real tree lints clean.
+
+Fixtures are written to tmp_path and run through the pass functions
+directly (no subprocess) except the CLI tests, which exercise exit
+codes the way CI consumes them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import core, hotpath, knobs, locks, outcome, retrace
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def lint(tmp_path, src, passes, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    files = core.load_tree([p], tmp_path)
+    ctx = core.Context(tmp_path)
+    return core.run_passes(files, ctx, passes)
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- hot-sync ----------------------------------------------------------------
+
+HOT_BAD = """
+    class Engine:
+        def _loop(self):
+            while True:
+                self._step()
+
+        def _step(self):
+            x = self._jit_decode(self._state)
+            v = float(x)          # blocking transfer in the dispatch loop
+            y = x.item()          # same
+            return v, y
+"""
+
+HOT_OK = """
+    class Engine:
+        def _loop(self):
+            while True:
+                self._step()
+
+        def _step(self):
+            x = self._jit_decode(self._state)
+            x.copy_to_host_async()
+            return x
+
+        def offline_tool(self):
+            # not reachable from any dispatch root: syncs are fine here
+            return float(self._jit_decode(self._state))
+"""
+
+
+def test_hotpath_positive(tmp_path):
+    fs = lint(tmp_path, HOT_BAD, [hotpath.run])
+    assert rules(fs) == ["hot-sync"]
+    assert len(fs) == 2
+    assert any(".item()" in f.message for f in fs)
+    assert any("float()" in f.message for f in fs)
+
+
+def test_hotpath_negative(tmp_path):
+    assert lint(tmp_path, HOT_OK, [hotpath.run]) == []
+
+
+def test_hotpath_block_until_ready_flagged_everywhere(tmp_path):
+    src = """
+        import jax
+        def helper(x):
+            jax.block_until_ready(x)
+    """
+    fs = lint(tmp_path, src, [hotpath.run])
+    assert len(fs) == 1 and "block_until_ready" in fs[0].message
+
+
+def test_hotpath_allow_comment_waives(tmp_path):
+    src = """
+        import jax
+        def warmup(x):
+            jax.block_until_ready(x)  # graftlint: allow(hot-sync) warmup sync
+    """
+    assert lint(tmp_path, src, [hotpath.run]) == []
+
+
+# --- lock-guard --------------------------------------------------------------
+
+LOCK_BAD = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._book = threading.Lock()
+            self._slots = []  # graftlint: guarded-by(_book)
+
+        def racy(self):
+            return len(self._slots)
+"""
+
+LOCK_OK = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._book = threading.Lock()
+            self._slots = []  # graftlint: guarded-by(_book)
+
+        def safe(self):
+            with self._book:
+                return len(self._slots)
+
+        def helper(self):  # graftlint: holds(_book)
+            self._slots.append(1)
+"""
+
+
+def test_locks_positive(tmp_path):
+    fs = lint(tmp_path, LOCK_BAD, [locks.run])
+    assert rules(fs) == ["lock-guard"]
+    assert len(fs) == 1
+    assert fs[0].qualname == "Engine.racy"
+    assert "_book" in fs[0].message
+
+
+def test_locks_negative(tmp_path):
+    # with-block, holds() annotation, and __init__ are all sanctioned
+    assert lint(tmp_path, LOCK_OK, [locks.run]) == []
+
+
+def test_locks_cross_object_access(tmp_path):
+    src = LOCK_OK + """
+    def exporter(eng):
+        return len(eng._slots)  # cannot take eng's lock correctly from here
+    """
+    fs = lint(tmp_path, src, [locks.run])
+    assert len(fs) == 1 and "outside Engine" in fs[0].message
+
+
+def test_locks_via_role(tmp_path):
+    src = """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.completed = 0  # graftlint: guarded-by(lock) via(stats)
+
+        class Engine:
+            def __init__(self):
+                self.stats = Stats()
+
+            def racy(self):
+                self.stats.completed += 1
+
+            def safe(self):
+                with self.stats.lock:
+                    self.stats.completed += 1
+    """
+    fs = lint(tmp_path, src, [locks.run])
+    assert len(fs) == 1 and fs[0].qualname == "Engine.racy"
+
+
+# --- retrace -----------------------------------------------------------------
+
+RETRACE_BAD = """
+    import jax
+
+    @jax.jit
+    def decode(x):
+        if x > 0:           # branching on a traced value
+            return x
+        return -x
+
+    def build(sizes):
+        fns = []
+        for n in sizes:
+            fns.append(jax.jit(lambda s: s[:n]))  # jit inside a loop
+        return fns
+"""
+
+RETRACE_OK = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def decode(x, n):
+        if n > 4:               # static arg: fine
+            return x
+        if x.shape[0] > 2:      # shape read: static, fine
+            return x + 1
+        return -x
+"""
+
+
+def test_retrace_positive(tmp_path):
+    fs = lint(tmp_path, RETRACE_BAD, [retrace.run])
+    assert rules(fs) == ["retrace"]
+    msgs = " | ".join(f.message for f in fs)
+    assert "branches on a traced value" in msgs
+    assert "inside a loop" in msgs
+
+
+def test_retrace_negative(tmp_path):
+    assert lint(tmp_path, RETRACE_OK, [retrace.run]) == []
+
+
+def test_retrace_unhashable_static_literal(tmp_path):
+    src = """
+        import jax
+
+        def _impl(x, dims):
+            return x
+
+        run = jax.jit(_impl, static_argnums=(1,))
+
+        def call(x):
+            return run(x, [1, 2, 3])
+    """
+    fs = lint(tmp_path, src, [retrace.run])
+    assert len(fs) == 1 and "unhashable" in fs[0].message
+
+
+# --- outcome -----------------------------------------------------------------
+
+OUTCOME_BAD = """
+    class Engine:
+        def _complete(self, req):
+            req.out.put(None)
+
+        def drop_error(self, req):
+            # error item but no path to the completer: waiter hangs
+            req.out.put({"error": "boom", "kind": "internal"})
+
+        def rogue(self, req):
+            req.out.put(None)
+
+        def swallow(self, req):
+            try:
+                self.dispatch(req)
+            except Exception:
+                pass
+"""
+
+OUTCOME_OK = """
+    class Engine:
+        def _complete(self, req):
+            req.out.put(None)
+
+        def _fail_req(self, req, msg):
+            req.out.put({"error": msg, "kind": "internal"})
+            self._complete(req)
+
+        def recover(self, req):
+            try:
+                self.dispatch(req)
+            except Exception as e:
+                self._fail_req(req, str(e))
+"""
+
+
+def test_outcome_positive(tmp_path):
+    fs = lint(tmp_path, OUTCOME_BAD, [outcome.run])
+    assert rules(fs) == ["outcome"]
+    by_qn = {f.qualname: f.message for f in fs}
+    assert "waiter hangs" in by_qn["Engine.drop_error"]          # O2
+    assert "outside the designated completer" in by_qn["Engine.rogue"]  # O1
+    assert "broad except" in by_qn["Engine.swallow"]             # O3
+    assert len(fs) == 3
+
+
+def test_outcome_negative(tmp_path):
+    assert lint(tmp_path, OUTCOME_OK, [outcome.run]) == []
+
+
+# --- env-knob ----------------------------------------------------------------
+
+def test_knobs_positive(tmp_path):
+    src = """
+        import os
+        FLAG = os.environ.get("GRAFTLINT_TEST_UNREGISTERED_KNOB", "0")
+    """
+    fs = lint(tmp_path, src, [knobs.run])
+    assert rules(fs) == ["env-knob"]
+    assert "GRAFTLINT_TEST_UNREGISTERED_KNOB" in fs[0].message
+
+
+def test_knobs_negative_registered_and_aliased(tmp_path):
+    # CHAOS is registered; reads through `import os as _os`, a module
+    # constant, and an environ alias must all resolve to it.
+    src = """
+        import os as _os
+        _CHAOS = "CHAOS"
+        env = _os.environ
+        a = _os.getenv("CHAOS")
+        b = _os.environ.get(_CHAOS)
+        c = env["CHAOS"] if "CHAOS" in _os.environ else "0"
+    """
+    assert lint(tmp_path, src, [knobs.run]) == []
+
+
+def test_knobs_dynamic_read_skipped(tmp_path):
+    src = """
+        import os
+        def read(name):
+            return os.environ.get(name)
+    """
+    assert lint(tmp_path, src, [knobs.run]) == []
+
+
+# --- baseline round-trip -----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    fs = lint(tmp_path, LOCK_BAD, [locks.run])
+    assert fs
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(bl, fs, {})
+    loaded = core.load_baseline(bl)
+    assert set(loaded) == {f.fingerprint for f in fs}
+    data = json.loads(bl.read_text())
+    assert data["version"] == 1
+    # notes survive a rewrite
+    loaded[fs[0].fingerprint]["note"] = "deliberate: single-threaded test rig"
+    core.write_baseline(bl, fs, loaded)
+    again = core.load_baseline(bl)
+    assert again[fs[0].fingerprint]["note"] == \
+        "deliberate: single-threaded test rig"
+
+
+def test_fingerprint_survives_line_drift(tmp_path):
+    fs1 = lint(tmp_path, LOCK_BAD, [locks.run], name="a.py")
+    fs2 = lint(tmp_path, "\n\n\n" + LOCK_BAD, [locks.run], name="a.py")
+    assert fs1[0].fingerprint == fs2[0].fingerprint
+    assert fs1[0].line != fs2[0].line
+
+
+# --- CLI / real tree ---------------------------------------------------------
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=cwd, capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": str(REPO)},
+    )
+
+
+@pytest.mark.lint
+def test_real_tree_is_clean_vs_baseline():
+    r = _cli()
+    assert r.returncode == 0, f"graftlint regressions:\n{r.stdout}\n{r.stderr}"
+
+
+@pytest.mark.lint
+def test_cli_fails_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(HOT_BAD))
+    r = _cli("--no-baseline", str(bad))
+    assert r.returncode == 1
+    assert "hot-sync" in r.stdout
+
+
+@pytest.mark.lint
+def test_cli_knobs_doc_is_fresh():
+    # docs/knobs.md must match what --gen-knobs would write (K3).
+    files = core.load_tree([REPO / "seldon_tpu", REPO / "tools"], REPO)
+    want = knobs.generate_knobs_md(knobs.scan_reads(files))
+    have = (REPO / "docs" / "knobs.md").read_text()
+    assert have == want, "docs/knobs.md is stale: run " \
+        "`python -m tools.graftlint --gen-knobs`"

@@ -1,0 +1,18 @@
+"""graftlint — project-specific static analysis for the seldon-tpu tree.
+
+Five composable AST passes enforce the invariants the chaos soak can only
+sample dynamically:
+
+  hot-sync     no host synchronisation inside the scheduler dispatch loop
+  lock-guard   fields declared ``# graftlint: guarded-by(<lock>)`` are only
+               touched under ``with self.<lock>:``
+  retrace      jitted functions must not pick up per-request Python state
+               that forces recompiles
+  outcome      request finalization emits exactly one terminal item
+  env-knob     every env var read appears in the generated knob table
+
+Run as ``python -m tools.graftlint seldon_tpu tools``.  Accepted findings
+live in ``graftlint_baseline.json``; CI fails only on regressions.
+"""
+
+from .core import Finding, SourceFile, load_tree, run_passes  # noqa: F401

@@ -1,0 +1,93 @@
+"""CLI: python -m tools.graftlint [paths...] [options]
+
+Exit codes: 0 clean (vs baseline), 1 findings, 2 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List
+
+from . import hotpath, knobs, locks, outcome, retrace
+from .core import (Context, Finding, load_baseline, load_tree, run_passes,
+                   write_baseline)
+
+PASSES = [hotpath.run, locks.run, retrace.run, outcome.run, knobs.run]
+
+
+def _repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="seldon-tpu invariant checker (hot-sync, lock-guard, "
+                    "retrace, outcome, env-knob)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files/dirs to lint (default: seldon_tpu tools)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report findings without baseline suppression")
+    ap.add_argument("--gen-knobs", action="store_true",
+                    help="regenerate docs/knobs.md and exit")
+    args = ap.parse_args(argv)
+
+    root = _repo_root()
+    targets = [Path(p).resolve() for p in args.paths] or \
+        [root / "seldon_tpu", root / "tools"]
+    for t in targets:
+        if not t.exists():
+            print(f"graftlint: no such path: {t}", file=sys.stderr)
+            return 2
+
+    ctx = Context(root)
+    files = load_tree(targets, root)
+
+    if args.gen_knobs:
+        reads = knobs.scan_reads(files)
+        ctx.knobs_doc.parent.mkdir(parents=True, exist_ok=True)
+        ctx.knobs_doc.write_text(knobs.generate_knobs_md(reads))
+        print(f"graftlint: wrote {ctx.knobs_doc.relative_to(root)}")
+        return 0
+
+    findings = run_passes(files, ctx, PASSES)
+
+    baseline = {} if args.no_baseline else load_baseline(ctx.baseline_path)
+    if args.write_baseline:
+        write_baseline(ctx.baseline_path, findings, baseline)
+        print(f"graftlint: baselined {len(findings)} finding(s) -> "
+              f"{ctx.baseline_path.name}")
+        return 0
+
+    fresh: List[Finding] = []
+    used = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            used.add(f.fingerprint)
+        else:
+            fresh.append(f)
+
+    stale = set(baseline) - used
+    for fp in sorted(stale):
+        e = baseline[fp]
+        print(f"graftlint: warning: stale baseline entry {fp} "
+              f"({e.get('rule')} in {e.get('file')}) — safe to drop",
+              file=sys.stderr)
+
+    for f in fresh:
+        print(f.render())
+    if fresh:
+        print(f"\ngraftlint: {len(fresh)} finding(s) "
+              f"({len(used)} suppressed by baseline)")
+        return 1
+    print(f"graftlint: OK — {len(findings)} finding(s), all accepted in "
+          f"baseline" if findings else "graftlint: OK — no findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

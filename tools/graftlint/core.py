@@ -1,0 +1,246 @@
+"""Shared plumbing for graftlint passes.
+
+A pass is a callable ``run(files, ctx) -> List[Finding]``.  This module
+owns everything rule-agnostic: source loading, ``# graftlint:`` control
+comments, stable fingerprints, and the baseline file that lets CI fail
+only on regressions.
+
+Control comments (all live in real comments, invisible to the AST):
+
+  # graftlint: guarded-by(<lock>)            field declaration; may only be
+  # graftlint: guarded-by(<lock>) via(<role>)  touched under with self.<lock>
+  # graftlint: holds(<lock>)                 on a def line: the caller holds
+                                             <lock>; body is in-lock context
+  # graftlint: allow(<rule>[, <rule>]) why   waive <rule> on this line, or
+                                             for the whole function when the
+                                             comment sits on its def line
+
+Fingerprints are ``rule:relpath:qualname:sha1(normalized source line)`` so
+baseline entries survive unrelated line drift but die when the flagged
+code actually changes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+BASELINE_NAME = "graftlint_baseline.json"
+
+_ALLOW_RE = re.compile(r"#\s*graftlint:\s*allow\(([\w\-, ]+)\)")
+_GUARDED_RE = re.compile(
+    r"#\s*graftlint:\s*guarded-by\((\w+)\)(?:\s+via\((\w+)\))?"
+)
+_HOLDS_RE = re.compile(r"#\s*graftlint:\s*holds\((\w+)\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+    qualname: str = ""
+    fingerprint: str = ""
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        out += f"\n    fingerprint: {self.fingerprint}"
+        return out
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: Path
+    rel: str
+    text: str
+    lines: List[str]
+    tree: ast.Module
+    # line -> set of waived rule ids ("*" waives everything on the line)
+    allows: Dict[int, Set[str]]
+    # line of a `def` -> lock name the caller is documented to hold
+    holds: Dict[int, str]
+    # (field, lock, via-role) declarations found in this file
+    guarded: List[Tuple[str, str, Optional[str], int]]
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Context:
+    """Run-wide state handed to every pass."""
+
+    def __init__(self, repo_root: Path):
+        self.repo_root = repo_root
+        self.baseline_path = repo_root / BASELINE_NAME
+        self.knobs_doc = repo_root / "docs" / "knobs.md"
+
+
+def _parse_controls(lines: Sequence[str]):
+    allows: Dict[int, Set[str]] = {}
+    holds: Dict[int, str] = {}
+    guarded: List[Tuple[str, str, Optional[str], int]] = []
+    for i, raw in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(raw)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            allows.setdefault(i, set()).update(rules)
+        m = _HOLDS_RE.search(raw)
+        if m:
+            holds[i] = m.group(1)
+        m = _GUARDED_RE.search(raw)
+        if m:
+            fm = re.search(r"self\.(\w+)", raw)
+            if fm:
+                guarded.append((fm.group(1), m.group(1), m.group(2), i))
+    return allows, holds, guarded
+
+
+def load_source(path: Path, repo_root: Path) -> SourceFile:
+    text = path.read_text()
+    lines = text.splitlines()
+    tree = ast.parse(text, filename=str(path))
+    allows, holds, guarded = _parse_controls(lines)
+    try:
+        rel = path.resolve().relative_to(repo_root.resolve()).as_posix()
+    except ValueError:  # fixture outside the repo (tests)
+        rel = path.resolve().as_posix()
+    return SourceFile(path, rel, text, lines, tree, allows, holds, guarded)
+
+
+def load_tree(targets: Sequence[Path], repo_root: Path) -> List[SourceFile]:
+    """Collect .py files under the target dirs, skipping generated code."""
+    files: List[SourceFile] = []
+    seen: Set[Path] = set()
+    for target in targets:
+        if target.is_file():
+            cands = [target]
+        else:
+            cands = sorted(target.rglob("*.py"))
+        for p in cands:
+            rp = p.resolve()
+            if rp in seen:
+                continue
+            seen.add(rp)
+            try:
+                rel = rp.relative_to(repo_root.resolve()).as_posix()
+            except ValueError:  # fixture outside the repo (tests)
+                rel = rp.as_posix()
+            if "/proto/" in f"/{rel}" and rel.endswith("_pb2.py"):
+                continue  # protoc output
+            if "__pycache__" in rel:
+                continue
+            try:
+                files.append(load_source(p, repo_root))
+            except SyntaxError as exc:  # surfaced as a finding, not a crash
+                files.append(
+                    SourceFile(p, rel, "", [], ast.Module(body=[], type_ignores=[]),
+                               {}, {}, [])
+                )
+                print(f"graftlint: syntax error in {rel}: {exc}", file=sys.stderr)
+    return files
+
+
+def allowed(sf: SourceFile, rule: str, *linenos: int) -> bool:
+    """True when any of the lines carries an allow() for this rule."""
+    for ln in linenos:
+        rules = sf.allows.get(ln)
+        if rules and (rule in rules or "*" in rules):
+            return True
+    return False
+
+
+def make_finding(sf: SourceFile, rule: str, line: int, message: str,
+                 hint: str = "", qualname: str = "") -> Finding:
+    norm = " ".join(sf.line_text(line).split())
+    digest = hashlib.sha1(
+        f"{rule}|{sf.rel}|{qualname}|{norm}".encode()
+    ).hexdigest()[:12]
+    return Finding(rule, sf.rel, line, message, hint, qualname, digest)
+
+
+# --- enclosing-scope helpers -------------------------------------------------
+
+def attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._graftlint_parent = node  # type: ignore[attr-defined]
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "_graftlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_graftlint_parent", None)
+    return None
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = getattr(node, "_graftlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = getattr(cur, "_graftlint_parent", None)
+    return None
+
+
+def qualname_of(node: ast.AST) -> str:
+    parts: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_graftlint_parent", None)
+    return ".".join(reversed(parts))
+
+
+# --- baseline ----------------------------------------------------------------
+
+def load_baseline(path: Path) -> Dict[str, dict]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {e["fingerprint"]: e for e in data.get("suppressions", [])}
+
+
+def write_baseline(path: Path, findings: Sequence[Finding],
+                   old: Dict[str, dict]) -> None:
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.line)):
+        note = old.get(f.fingerprint, {}).get("note", "TODO: justify")
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "file": f.path,
+            "qualname": f.qualname,
+            "note": note,
+        })
+    path.write_text(json.dumps({"version": 1, "suppressions": entries},
+                               indent=2) + "\n")
+
+
+def run_passes(files: List[SourceFile], ctx: Context,
+               passes: Sequence) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in passes:
+        findings.extend(p(files, ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    seen: Set[str] = set()
+    unique = []
+    for f in findings:
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            unique.append(f)
+    return unique

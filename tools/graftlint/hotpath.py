@@ -1,0 +1,173 @@
+"""hot-sync pass: no host synchronisation inside the dispatch hot loop.
+
+The engine's throughput rests on the dispatch loop never blocking on the
+device: kernels are enqueued asynchronously and results come back through
+``copy_to_host_async`` + the boundary fetcher thread.  A single stray
+``block_until_ready`` / ``.item()`` / ``device_get`` in that loop
+serialises every dispatch against device completion.
+
+Mechanics: per class, build a ``self.<method>()`` call graph rooted at the
+scheduler loop methods (``_loop`` / ``_loop_async`` / ``_loop_sync`` /
+``_fetch_loop`` / ``_dispatch_once`` / ``step``) and flag, inside the
+reachable set:
+
+  * ``jax.device_get(...)`` and ``.item()`` calls (always a sync)
+  * ``float(x)`` / ``int(x)`` / ``np.asarray(x)`` where ``x`` is
+    device-tainted (assigned from a ``self._jit*`` dispatch or from
+    ``self._state``) — implicit device->host transfer
+
+``block_until_ready`` is flagged everywhere in the scanned tree, not just
+in the reachable set: outside an explicitly allowed warmup/boundary site
+it is never correct in serving code.
+
+Waive intentional boundary syncs with ``# graftlint: allow(hot-sync) why``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (Context, Finding, SourceFile, allowed, attach_parents,
+                   enclosing_function, make_finding, qualname_of)
+
+RULE = "hot-sync"
+
+ROOT_NAMES = {"_loop", "_loop_async", "_loop_sync", "_fetch_loop",
+              "_dispatch_once", "step"}
+
+
+def _self_calls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            out.add(node.func.attr)
+    return out
+
+
+def _reachable_methods(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    roots = [n for n in methods if n in ROOT_NAMES]
+    seen: Set[str] = set()
+    work = list(roots)
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for callee in _self_calls(methods[name]):
+            if callee in methods and callee not in seen:
+                work.append(callee)
+    return {n: methods[n] for n in seen}
+
+
+def _is_device_source(expr: ast.AST) -> bool:
+    """Expressions whose value lives on-device: jit dispatch results and
+    reads of the engine's device-resident state pytree."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            if node.attr.startswith("_jit"):
+                return True
+            if (node.attr == "_state" and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return True
+    return False
+
+
+def _tainted_locals(fn: ast.AST) -> Set[str]:
+    tainted: Set[str] = set()
+
+    def expr_tainted(e: ast.AST) -> bool:
+        if _is_device_source(e):
+            return True
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in ast.walk(e))
+
+    def mark(target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                mark(el)
+
+    for _ in range(2):  # fixpoint-ish; two passes cover forward chains
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for t in node.targets:
+                    mark(t)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and expr_tainted(node.value):
+                mark(node.target)
+            elif isinstance(node, ast.For) and expr_tainted(node.iter):
+                mark(node.target)
+    return tainted
+
+
+def _def_line(node: ast.AST) -> int:
+    fn = enclosing_function(node)
+    return fn.lineno if fn is not None else 0
+
+
+def run(files: List[SourceFile], ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        attach_parents(sf.tree)
+
+        # block_until_ready: flagged anywhere in the tree.
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                if allowed(sf, RULE, node.lineno, _def_line(node)):
+                    continue
+                findings.append(make_finding(
+                    sf, RULE, node.lineno,
+                    "block_until_ready stalls the host on device completion",
+                    "move the sync to a warmup/boundary site and annotate it "
+                    "`# graftlint: allow(hot-sync) <why>`",
+                    qualname_of(node)))
+
+        # The rest only applies inside the dispatch-reachable set.
+        for cls in [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]:
+            for mname, fn in _reachable_methods(cls).items():
+                tainted = _tainted_locals(fn)
+                qn = f"{cls.name}.{mname}"
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    hit: Optional[str] = None
+                    hint = ""
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and f.attr == "item":
+                        hit = ".item() forces a device->host sync"
+                        hint = "keep the value on device, or fetch it at the boundary"
+                    elif isinstance(f, ast.Attribute) and f.attr == "device_get" \
+                            and isinstance(f.value, ast.Name) and f.value.id == "jax":
+                        hit = "jax.device_get blocks on device completion"
+                        hint = "use copy_to_host_async and read at the next boundary"
+                    elif isinstance(f, ast.Name) and f.id in ("float", "int") \
+                            and node.args and any(
+                                isinstance(n, ast.Name) and n.id in tainted
+                                for n in ast.walk(node.args[0])):
+                        hit = (f"{f.id}() on a device value implies a blocking "
+                               "transfer")
+                        hint = "fetch at the boundary, then convert on host"
+                    elif isinstance(f, ast.Attribute) and f.attr == "asarray" \
+                            and isinstance(f.value, ast.Name) \
+                            and f.value.id in ("np", "numpy") and node.args \
+                            and any(isinstance(n, ast.Name) and n.id in tainted
+                                    for n in ast.walk(node.args[0])):
+                        hit = "np.asarray of a device array copies synchronously"
+                        hint = "use copy_to_host_async + boundary fetch"
+                    if hit is None:
+                        continue
+                    if allowed(sf, RULE, node.lineno, fn.lineno):
+                        continue
+                    findings.append(make_finding(
+                        sf, RULE, node.lineno,
+                        f"{hit} (reachable from the dispatch loop via {qn})",
+                        hint, qn))
+    return findings

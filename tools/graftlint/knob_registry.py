@@ -1,0 +1,211 @@
+"""The env-knob registry: every environment variable the tree may read.
+
+``docs/knobs.md`` is generated from this table plus the read sites the
+env-knob pass discovers (``python -m tools.graftlint --gen-knobs``).
+Adding an ``os.environ`` read without registering it here fails
+``make lint``.
+
+Groups in ``EXTERNAL_GROUPS`` are exempt from the stale-entry check: the
+value is owned by the platform (JAX, the kubelet, cloud SDKs) so a knob
+may stay registered even when no scanned file currently reads it.
+
+Bench-harness phase knobs (``BENCH_*``) are documented in
+``docs/benchmarking.md``; ``bench.py`` lives outside the linted tree.
+"""
+
+EXTERNAL_GROUPS = {"platform"}
+
+
+def _k(group, default, desc):
+    return {"group": group, "default": default, "desc": desc}
+
+
+KNOBS = {
+    # --- engine serving (servers/jaxserver.py unit-param fallbacks) -------
+    "WEIGHT_DTYPE": _k("engine-serving", "(checkpoint dtype)",
+                       "Override weight dtype at load, e.g. `int8` to serve a "
+                       "bf16 HF checkpoint quantized."),
+    "ACT_DTYPE": _k("engine-serving", "(follows weights)",
+                    "W8A8 activation dtype for int8 weights (`int8`/`bf16`)."),
+    "PREFIX_CACHE": _k("engine-serving", "0",
+                       "Enable prompt-prefix KV reuse (radix trie over "
+                       "block-aligned prefixes)."),
+    "PREFIX_CACHE_MB": _k("engine-serving", "0 (auto)",
+                          "HBM budget for retained prefix KV, in MiB."),
+    "CHUNKED_PREFILL": _k("engine-serving", "0",
+                          "Interleave prefill chunks with decode steps "
+                          "(stall-free scheduling)."),
+    "PREFILL_CHUNK": _k("engine-serving", "0 (model block)",
+                        "Prefill chunk length in tokens."),
+    "DISPATCH_TOKEN_BUDGET": _k("engine-serving", "0 (auto)",
+                                "Per-dispatch token budget shared by decode "
+                                "and prefill chunks."),
+    "PAGED_KV": _k("engine-serving", "0",
+                   "Paged KV cache: global block pool + per-slot block "
+                   "tables instead of dense per-slot slabs."),
+    "KV_BLOCK": _k("engine-serving", "0 (model default)",
+                   "KV block size in tokens (paged mode)."),
+    "KV_POOL_MB": _k("engine-serving", "0 (dense-equivalent)",
+                     "KV pool size in HBM MiB (paged mode)."),
+    "MAX_QUEUE": _k("engine-serving", "0 (unbounded)",
+                    "Admission queue bound; past it submit() sheds with "
+                    "a retriable 429 EngineOverloaded."),
+    "DEFAULT_DEADLINE_MS": _k("engine-serving", "0 (none)",
+                              "Default per-request TTL in ms; per-request "
+                              "deadline_ms still wins."),
+
+    # --- chaos fault injection (servers/chaos.py, env-only by design) -----
+    "CHAOS": _k("chaos", "0", "Master switch (`1`/`true`/`yes`); never a "
+                "unit parameter, so manifests cannot enable it by accident."),
+    "CHAOS_SEED": _k("chaos", "0", "Seed for the deterministic fault "
+                     "sequence; replays a failure byte-for-byte."),
+    "CHAOS_DISPATCH_FAIL": _k("chaos", "0", "Probability a dispatch raises "
+                              "(drives _fail_all rebuild)."),
+    "CHAOS_ALLOC_FAIL": _k("chaos", "0", "Probability a paged-pool "
+                           "allocation is refused."),
+    "CHAOS_SLOW_BOUNDARY": _k("chaos", "0", "Probability a boundary fetch "
+                              "is artificially delayed."),
+    "CHAOS_SLOW_MS": _k("chaos", "5", "Delay for a slow boundary, ms."),
+    "CHAOS_DISCONNECT": _k("chaos", "0", "Probability a client disconnect "
+                           "is injected (stream close -> cancel)."),
+
+    # --- runtime microservice / persistence / tracing ---------------------
+    "API_TYPE": _k("runtime", "REST,GRPC", "Transports to serve."),
+    "SERVICE_TYPE": _k("runtime", "MODEL",
+                       "Role of this unit (MODEL/ROUTER/TRANSFORMER/...)."),
+    "PERSISTENCE": _k("runtime", "0", "Enable model-state persistence "
+                      "(Redis-backed save/restore)."),
+    "PREDICTIVE_UNIT_PARAMETERS": _k("runtime", "[]",
+                                     "JSON list of unit parameters injected "
+                                     "by the operator."),
+    "PREDICTIVE_UNIT_SERVICE_PORT": _k("runtime", "9000",
+                                       "Microservice listen port."),
+    "PREDICTIVE_UNIT_ID": _k("runtime", "model/unit",
+                             "Unit name stamped on responses and state keys."),
+    "PREDICTOR_ID": _k("runtime", "predictor", "Predictor name for state "
+                       "keys."),
+    "SELDON_DEPLOYMENT_ID": _k("runtime", "dep", "Deployment name for state "
+                               "keys."),
+    "SELDON_TPU_FASTPATH": _k("runtime", "1", "Skip flask/reloader overhead "
+                              "on the REST data path (`0` disables)."),
+    "SELDON_TPU_STATE_DIR": _k("runtime", "/tmp/seldon-tpu-state",
+                               "Local fallback directory for persisted "
+                               "state when Redis is absent."),
+    "PERSISTENCE_PUSH_FREQUENCY": _k("runtime", "300",
+                                     "Seconds between persistence pushes."),
+    "REDIS_SERVICE_HOST": _k("runtime", "(unset)", "Redis host; unset "
+                             "selects the local-file persistence fallback."),
+    "REDIS_SERVICE_PORT": _k("runtime", "6379", "Redis port."),
+    "TRACING": _k("runtime", "0", "Enable request tracing."),
+    "TRACING_FILE": _k("runtime", "(stdout)", "JSONL trace sink path."),
+    "PODINFO_ANNOTATIONS": _k("runtime", "/etc/podinfo/annotations",
+                              "Downward-API annotations file."),
+    "PREDICTOR_HOST": _k("runtime", "(unset)",
+                         "Predictor endpoint an explainer calls back into."),
+
+    # --- orchestrator -----------------------------------------------------
+    "ENGINE_PREDICTOR": _k("orchestrator", "(unset)",
+                           "Base64 predictor spec the service orchestrator "
+                           "deserializes at boot."),
+    "ENGINE_WORKERS": _k("orchestrator", "1",
+                         "Orchestrator worker processes."),
+    "SELDON_TPU_GRPC_WORKERS": _k("orchestrator", "8",
+                                  "gRPC server thread-pool size."),
+    "PORT": _k("orchestrator", "8080", "Request-logger listen port."),
+    "SELDON_MESSAGE_LOGGING_SERVICE": _k("orchestrator", "(disabled)",
+                                         "URL of the request/response "
+                                         "logging sink."),
+
+    # --- operator / storage ----------------------------------------------
+    "WEBHOOK_CERT_DIR": _k("operator-storage",
+                           "/tmp/k8s-webhook-server/serving-certs",
+                           "Admission-webhook TLS cert directory."),
+    "KUBECONFIG": _k("operator-storage", "~/.kube/config",
+                     "Kubeconfig path when running out-of-cluster."),
+    "SELDON_TPU_LOCALSTORE_DEBUG": _k("operator-storage", "0",
+                                      "Verbose local object-store logging."),
+    "SELDON_TPU_MODEL_DIR": _k("operator-storage", "/mnt/models",
+                               "Download target for model artifacts."),
+    "AZURE_SAS_TOKEN": _k("operator-storage", "(unset)",
+                          "SAS token appended to Azure blob downloads."),
+    "SAGEMAKER_ENDPOINT_NAME": _k("operator-storage", "(unset)",
+                                  "SageMaker endpoint the proxy server "
+                                  "invokes."),
+    "SAGEMAKER_RUNTIME_URL": _k("operator-storage", "(regional default)",
+                                "Override for the SageMaker runtime URL."),
+
+    # --- multi-host TPU slice (parallel/distributed.py) -------------------
+    "TPU_WORKER_HOSTNAMES_SVC": _k("distributed", "(unset)",
+                                   "Headless-service name enumerating slice "
+                                   "workers."),
+    "TPU_WORKER_COUNT": _k("distributed", "1",
+                           "Expected process count in the slice."),
+    "TPU_COORDINATOR_PORT": _k("distributed", "(jax default)",
+                               "Coordinator port for "
+                               "jax.distributed.initialize."),
+
+    # --- bench & probe tools (tools/*.py, CPU-smoke friendly) -------------
+    "MB_PRESET": _k("bench-tools", "bench-1b", "Decode microbench model "
+                    "preset (also profile_decode)."),
+    "MB_SLOTS": _k("bench-tools", "160", "Microbench batch slots."),
+    "MB_WINDOW": _k("bench-tools", "257", "Microbench KV window."),
+    "MB_ACT": _k("bench-tools", "(follows weights)", "Microbench activation "
+                 "dtype."),
+    "TUNE_ACT": _k("bench-tools", "int8", "Activation dtype for the 8b "
+                   "tuning sweep."),
+    "PROBE_PRESET": _k("bench-tools", "llama3-8b", "Slot-cliff probe preset "
+                       "(`tiny` = CPU smoke)."),
+    "PROBE_PAGED": _k("bench-tools", "0", "Add the paged-KV sweep to "
+                      "probe_hbm / probe_slot_cliff."),
+    "PB_PRESET": _k("bench-tools", "tiny", "Prefix-cache probe preset."),
+    "PB_PROMPT": _k("bench-tools", "128", "Prefix probe prompt length."),
+    "PB_BLOCK": _k("bench-tools", "16", "Prefix probe trie block size."),
+    "PB_NREQ": _k("bench-tools", "16", "Prefix probe request count."),
+    "PB_KV": _k("bench-tools", "(preset dtype)", "Prefix probe KV dtype."),
+    "PB_SHARED_FRAC": _k("bench-tools", "0.5", "Fraction of requests "
+                         "sharing the warm prefix."),
+    "PC_PRESET": _k("bench-tools", "tiny", "Chunked-prefill probe preset."),
+    "PC_PROMPT": _k("bench-tools", "32", "Chunked probe short-prompt "
+                    "length."),
+    "PC_LONG": _k("bench-tools", "8*PC_PROMPT", "Chunked probe interloper "
+                  "prompt length."),
+    "PC_CHUNK": _k("bench-tools", "PC_PROMPT", "Prefill chunk length."),
+    "PC_BUDGET": _k("bench-tools", "PC_CHUNK", "Dispatch token budget."),
+    "PC_STREAMS": _k("bench-tools", "4", "Concurrent decode streams."),
+    "PC_NEW": _k("bench-tools", "64", "New tokens per stream."),
+    "PC_KV": _k("bench-tools", "(preset dtype)", "Chunked probe KV dtype."),
+    "CH_PRESET": _k("bench-tools", "tiny", "Chaos probe preset."),
+    "CH_N": _k("bench-tools", "200", "Chaos probe request count."),
+    "CH_SEED": _k("bench-tools", "0", "Chaos probe fault seed."),
+    "CH_DISPATCH_FAIL": _k("bench-tools", "0.02", "Chaos probe dispatch "
+                           "fault rate."),
+    "CH_ALLOC_FAIL": _k("bench-tools", "0.02", "Chaos probe alloc fault "
+                        "rate."),
+    "CH_SLOW": _k("bench-tools", "0.05", "Chaos probe slow-boundary rate."),
+    "CH_DISCONNECT": _k("bench-tools", "0.01", "Chaos probe disconnect "
+                        "rate."),
+    "CH_PAGED": _k("bench-tools", "0", "Chaos probe paged-KV mode."),
+    "CH_DEADLINE_FRAC": _k("bench-tools", "0.1", "Fraction of chaos probe "
+                           "requests given tight deadlines."),
+    "CH_CANCEL_FRAC": _k("bench-tools", "0.1", "Fraction of chaos probe "
+                         "requests cancelled mid-flight."),
+
+    # --- platform (owned by JAX / Kubernetes / cloud SDKs) ----------------
+    "JAX_PLATFORMS": _k("platform", "(auto)", "JAX backend selection; "
+                        "`cpu` pins tests and probes off the TPU."),
+    "KUBERNETES_SERVICE_HOST": _k("platform", "kubernetes.default.svc",
+                                  "In-cluster API host (set by the "
+                                  "kubelet)."),
+    "KUBERNETES_SERVICE_PORT": _k("platform", "443", "In-cluster API port."),
+    "AWS_ACCESS_KEY_ID": _k("platform", "(unset)", "SageMaker proxy "
+                            "credentials."),
+    "AWS_SECRET_ACCESS_KEY": _k("platform", "(unset)", "SageMaker proxy "
+                                "credentials."),
+    "AWS_SESSION_TOKEN": _k("platform", "(unset)", "SageMaker proxy "
+                            "credentials."),
+    "AWS_REGION": _k("platform", "us-east-1", "SageMaker proxy region."),
+    "HOSTNAME": _k("platform", "(pod name)", "Used to derive the process "
+                   "index within a TPU slice."),
+    "PYTHONPATH": _k("platform", "(inherited)", "Propagated to operator "
+                     "local-mode child processes."),
+}

@@ -1,0 +1,234 @@
+"""env-knob pass: every environment variable read appears in the knob table.
+
+Serving behaviour is steered by dozens of env knobs (PAGED_KV, KV_BLOCK,
+MAX_QUEUE, CHAOS, ...).  An undocumented knob is an operational landmine:
+it changes production behaviour and appears in no runbook.  This pass
+keeps ``docs/knobs.md`` honest by construction:
+
+  K1  an ``os.environ`` / ``os.getenv`` read whose name is not registered
+      in ``tools/graftlint/knob_registry.py``
+  K2  a registered knob no scanned file reads (stale registry entry) —
+      groups listed in ``EXTERNAL_GROUPS`` are exempt (read by JAX, the
+      kubelet, cloud SDKs, tests, ...)
+  K3  ``docs/knobs.md`` differs from the generated table — regenerate
+      with ``python -m tools.graftlint --gen-knobs``
+
+Name resolution handles string literals, module-level string constants
+(``ENV_FOO = "FOO"; os.environ.get(ENV_FOO)``), function parameter
+defaults resolving to either, and local aliases of ``os.environ``.
+Reads through genuinely dynamic names are skipped.  Writes are skipped.
+
+Waive with ``# graftlint: allow(env-knob) why``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Context, Finding, SourceFile, allowed, attach_parents,
+                   enclosing_function, make_finding, qualname_of)
+from .knob_registry import EXTERNAL_GROUPS, KNOBS
+
+RULE = "env-knob"
+
+REGISTRY_REL = "tools/graftlint/knob_registry.py"
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    consts[t.id] = node.value.value
+    return consts
+
+
+def _param_defaults(fn: ast.AST, consts: Dict[str, str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        v = _resolve(d, consts, {})
+        if v is not None:
+            out[p.arg] = v
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            v = _resolve(d, consts, {})
+            if v is not None:
+                out[p.arg] = v
+    return out
+
+
+def _resolve(expr: ast.AST, consts: Dict[str, str],
+             locals_: Dict[str, str]) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return locals_.get(expr.id) or consts.get(expr.id)
+    return None
+
+
+def _os_names(tree: ast.Module) -> Set[str]:
+    """Module names the `os` module is bound to (`import os as _os`)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "os":
+                    names.add(alias.asname or "os")
+    return names or {"os"}
+
+
+def _environ_aliases(tree: ast.Module, os_names: Set[str]) -> Set[str]:
+    """Names assigned from os.environ anywhere in the file, including
+    `env = environ if environ is not None else os.environ`."""
+
+    def mentions_environ(e: ast.AST) -> bool:
+        return any(isinstance(n, ast.Attribute) and n.attr == "environ"
+                   and isinstance(n.value, ast.Name) and n.value.id in os_names
+                   for n in ast.walk(e))
+
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and mentions_environ(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases.add(t.id)
+    return aliases
+
+
+def _is_environ(expr: ast.AST, aliases: Set[str], os_names: Set[str]) -> bool:
+    if isinstance(expr, ast.Attribute) and expr.attr == "environ" \
+            and isinstance(expr.value, ast.Name) and expr.value.id in os_names:
+        return True
+    if isinstance(expr, ast.Name) and expr.id in aliases:
+        return True
+    return False
+
+
+def scan_reads(files: List[SourceFile]) -> List[Tuple[str, SourceFile, int, str]]:
+    """All resolvable env reads: (var name, file, line, qualname)."""
+    reads: List[Tuple[str, SourceFile, int, str]] = []
+    for sf in files:
+        attach_parents(sf.tree)
+        consts = _module_str_constants(sf.tree)
+        os_names = _os_names(sf.tree)
+        aliases = _environ_aliases(sf.tree, os_names)
+
+        for node in ast.walk(sf.tree):
+            name_expr: Optional[ast.AST] = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                # os.getenv("X") / os.environ.get("X")
+                if isinstance(f, ast.Attribute) and f.attr == "getenv" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in os_names and node.args:
+                    name_expr = node.args[0]
+                elif isinstance(f, ast.Attribute) and f.attr == "get" \
+                        and _is_environ(f.value, aliases, os_names) and node.args:
+                    name_expr = node.args[0]
+            elif isinstance(node, ast.Subscript) \
+                    and _is_environ(node.value, aliases, os_names) \
+                    and not isinstance(node.ctx, (ast.Store, ast.Del)):
+                name_expr = node.slice
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                    and _is_environ(node.comparators[0], aliases, os_names):
+                name_expr = node.left
+            if name_expr is None:
+                continue
+            fn = enclosing_function(node)
+            locals_: Dict[str, str] = _param_defaults(fn, consts) if fn else {}
+            var = _resolve(name_expr, consts, locals_)
+            if var is None:
+                continue  # dynamic read — not statically knowable
+            reads.append((var, sf, node.lineno, qualname_of(node)))
+    return reads
+
+
+def generate_knobs_md(reads: List[Tuple[str, SourceFile, int, str]]) -> str:
+    sites: Dict[str, Set[str]] = {}
+    for var, sf, _line, _qn in reads:
+        sites.setdefault(var, set()).add(sf.rel)
+    groups: Dict[str, List[str]] = {}
+    for name, meta in KNOBS.items():
+        groups.setdefault(meta["group"], []).append(name)
+
+    lines = [
+        "# Environment knobs",
+        "",
+        "<!-- generated by `python -m tools.graftlint --gen-knobs` — do not edit by hand -->",
+        "",
+        "Every environment variable the serving tree reads, kept in sync with",
+        "the code by graftlint's env-knob pass (an unregistered read fails",
+        "`make lint`).  Registry: `tools/graftlint/knob_registry.py`.",
+        "Bench-harness phase knobs (`BENCH_*`) live in",
+        "[benchmarking.md](benchmarking.md).",
+        "",
+    ]
+    for group in sorted(groups):
+        title = group.replace("-", " ").capitalize()
+        lines += [f"## {title}", "",
+                  "| Knob | Default | Read in | Description |",
+                  "| --- | --- | --- | --- |"]
+        for name in sorted(groups[group]):
+            meta = KNOBS[name]
+            where = ", ".join(f"`{s}`" for s in sorted(sites.get(name, set()))) \
+                or "_(external reader)_"
+            lines.append(f"| `{name}` | `{meta['default']}` | {where} | "
+                         f"{meta['desc']} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def run(files: List[SourceFile], ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    reads = scan_reads(files)
+    seen: Set[str] = set()
+    for var, sf, line, qn in reads:
+        seen.add(var)
+        if var in KNOBS:
+            continue
+        fn_lines = []
+        if allowed(sf, RULE, line, *fn_lines):
+            continue
+        findings.append(make_finding(
+            sf, RULE, line,
+            f"env var '{var}' read here but not registered in the knob table",
+            f"add '{var}' to {REGISTRY_REL} and regenerate docs/knobs.md "
+            "with --gen-knobs (or delete the read)",
+            qn))
+
+    # K2/K3 only make sense on a full-tree scan — linting a lone fixture
+    # file must not report every registry entry as stale.
+    reg_sf = next((sf for sf in files if sf.rel == REGISTRY_REL), None)
+    if reg_sf is None:
+        return findings
+
+    # K2: stale registry entries
+    for name, meta in KNOBS.items():
+        if name in seen or meta["group"] in EXTERNAL_GROUPS:
+            continue
+        decl_line = next((i for i, t in enumerate(reg_sf.lines, 1)
+                          if f'"{name}"' in t), 1)
+        findings.append(make_finding(
+            reg_sf, RULE, decl_line,
+            f"registered knob '{name}' is read by no scanned file",
+            "remove the stale entry or mark its group external",
+            name))
+
+    # K3: docs/knobs.md freshness
+    want = generate_knobs_md(reads)
+    doc = ctx.knobs_doc
+    have = doc.read_text() if doc.exists() else ""
+    if have != want:
+        findings.append(make_finding(
+            reg_sf, RULE, 1,
+            "docs/knobs.md is stale relative to the registry and the "
+            "scanned reads",
+            "run `python -m tools.graftlint --gen-knobs`",
+            "docs/knobs.md"))
+    return findings

@@ -1,0 +1,186 @@
+"""lock-guard pass: declared fields are only touched under their lock.
+
+Fields are declared at their initialising assignment with a trailing
+comment::
+
+    self._slots = [None] * B  # graftlint: guarded-by(_book)
+
+Every ``self._slots`` read/write in the declaring class must then sit
+lexically inside ``with self._book:`` — or inside a method whose def line
+carries ``# graftlint: holds(_book)``, documenting that the caller owns
+the lock (the scheduler's ``_dispatch_once`` helpers, the ``*_locked``
+convention).
+
+A declaration may add ``via(<role>)``::
+
+    self.pool_gauges = None  # graftlint: guarded-by(lock) via(stats)
+
+which extends checking across the tree: any ``<base>.stats.pool_gauges``
+access in any scanned file must sit inside ``with <base>.stats.lock:``
+(same base expression).  This is how engine-side mutations of
+``EngineStats`` counters are kept honest.
+
+``__init__`` bodies are exempt (the object is not yet published to other
+threads).  Waive a deliberate lock-free access with
+``# graftlint: allow(lock-guard) why``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Context, Finding, SourceFile, allowed, attach_parents,
+                   enclosing_class, enclosing_function, make_finding,
+                   qualname_of)
+
+RULE = "lock-guard"
+
+
+@dataclasses.dataclass
+class _Decl:
+    cls: str      # declaring class name
+    field: str
+    lock: str
+    role: Optional[str]  # via(<role>) — cross-class attribute path
+    file: str
+    line: int
+
+
+def _collect_decls(files: List[SourceFile]) -> List[_Decl]:
+    decls: List[_Decl] = []
+    for sf in files:
+        if not sf.guarded:
+            continue
+        # map declaration lines to their enclosing class
+        classes = [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]
+        # a declaration must sit on a real `self.<field> = ...` statement —
+        # this keeps guarded-by examples in docstrings from registering
+        assign_lines: Set[int] = set()
+        for n in ast.walk(sf.tree):
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                assign_lines.add(n.lineno)
+        for field, lock, role, line in sf.guarded:
+            if line not in assign_lines:
+                continue
+            owner = ""
+            for c in classes:
+                end = getattr(c, "end_lineno", c.lineno)
+                if c.lineno <= line <= end:
+                    owner = c.name  # innermost match wins (last in walk order)
+            decls.append(_Decl(owner, field, lock, role, sf.rel, line))
+    return decls
+
+
+def _with_locks(node: ast.AST) -> Set[str]:
+    """Lock expressions (ast.dump of the context expr) held at `node`,
+    walking With ancestors."""
+    held: Set[str] = set()
+    cur = getattr(node, "_graftlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                held.add(ast.dump(item.context_expr))
+        cur = getattr(cur, "_graftlint_parent", None)
+    return held
+
+
+def _self_lock_dump(lock: str) -> str:
+    return ast.dump(ast.parse(f"self.{lock}", mode="eval").body)
+
+
+def _holds_lock(sf: SourceFile, node: ast.AST, lock: str) -> bool:
+    fn = enclosing_function(node)
+    while fn is not None:
+        if sf.holds.get(fn.lineno) == lock:
+            return True
+        fn = enclosing_function(fn)
+    return False
+
+
+def _in_init(node: ast.AST) -> bool:
+    fn = enclosing_function(node)
+    while fn is not None:
+        if fn.name == "__init__":
+            return True
+        fn = enclosing_function(fn)
+    return False
+
+
+def run(files: List[SourceFile], ctx: Context) -> List[Finding]:
+    decls = _collect_decls(files)
+    if not decls:
+        return []
+    by_field: Dict[str, List[_Decl]] = {}
+    for d in decls:
+        by_field.setdefault(d.field, []).append(d)
+
+    findings: List[Finding] = []
+    for sf in files:
+        attach_parents(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute) or node.attr not in by_field:
+                continue
+            for d in by_field[node.attr]:
+                fin = _check_access(sf, node, d)
+                if fin is not None:
+                    findings.append(fin)
+                    break
+    return findings
+
+
+def _check_access(sf: SourceFile, node: ast.Attribute,
+                  d: _Decl) -> Optional[Finding]:
+    base = node.value
+    in_decl_class = (isinstance(base, ast.Name) and base.id == "self"
+                     and (enclosing_class(node) is not None
+                          and enclosing_class(node).name == d.cls))
+    via_match = (d.role is not None and isinstance(base, ast.Attribute)
+                 and base.attr == d.role)
+    outside = (d.role is None
+               and not (isinstance(base, ast.Name) and base.id in ("self", "cls")))
+    if not in_decl_class and not via_match and not outside:
+        return None
+    if _in_init(node):
+        return None
+    if node.lineno == d.line and sf.rel == d.file:
+        return None  # the declaration itself
+
+    if outside:
+        fn = enclosing_function(node)
+        if allowed(sf, RULE, node.lineno, fn.lineno if fn else 0):
+            return None
+        return make_finding(
+            sf, RULE, node.lineno,
+            f"guarded field '{d.field}' (lock {d.lock}, declared "
+            f"{d.file}:{d.line}) accessed from outside {d.cls} — the lock "
+            "cannot be taken correctly from here",
+            f"add a locked accessor on {d.cls} and call that instead",
+            qualname_of(node))
+
+    if in_decl_class:
+        required = _self_lock_dump(d.lock)
+        lock_desc = f"self.{d.lock}"
+    else:
+        # require `with <base>.<role>.<lock>:` over the same base expression
+        lock_expr = ast.Attribute(
+            value=base, attr=d.lock, ctx=ast.Load())
+        required = ast.dump(lock_expr)
+        lock_desc = f"<obj>.{d.role}.{d.lock}"
+
+    if required in _with_locks(node):
+        return None
+    if _holds_lock(sf, node, d.lock):
+        return None
+    fn = enclosing_function(node)
+    fn_line = fn.lineno if fn is not None else 0
+    if allowed(sf, RULE, node.lineno, fn_line):
+        return None
+    return make_finding(
+        sf, RULE, node.lineno,
+        f"field '{d.field}' (guarded by {d.lock}, declared "
+        f"{d.file}:{d.line}) accessed outside `with {lock_desc}:`",
+        f"wrap the access in `with {lock_desc}:`, or annotate the method "
+        f"`# graftlint: holds({d.lock})` if every caller owns the lock",
+        qualname_of(node))

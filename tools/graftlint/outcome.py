@@ -1,0 +1,180 @@
+"""outcome pass: request finalization emits exactly one terminal item.
+
+The PR-4 lifecycle invariant: every submitted request terminates with
+exactly one of {completion, typed error item, cancel}, followed by exactly
+one ``None`` sentinel on ``req.out`` — so no waiter ever hangs and no
+waiter sees two outcomes.  The chaos soak samples this; here we check the
+shape of the code that has to uphold it.
+
+Scope: any class containing a sentinel put (``<x>.out.put(None)``) is a
+*finalizer class*.  Within it:
+
+  O1  only one method (the completer) may put the ``None`` sentinel; a
+      rogue sentinel elsewhere risks double-None or an early sentinel
+      racing the real outcome
+  O2  a typed error item (dict with an ``"error"`` key put on ``.out``)
+      must be emitted by a method that also reaches the completer —
+      otherwise the error is delivered but the waiter hangs forever
+      waiting for its sentinel
+  O3  a broad ``except Exception`` / bare ``except`` inside the class
+      must finalize (call a method that transitively reaches the
+      completer) or re-raise; swallowing the exception silently leaks
+      every in-flight request
+
+Waive with ``# graftlint: allow(outcome) why``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (Context, Finding, SourceFile, allowed, attach_parents,
+                   enclosing_function, make_finding, qualname_of)
+
+RULE = "outcome"
+
+
+def _is_out_put(node: ast.AST) -> Optional[ast.Call]:
+    """Match `<expr>.out.put(arg)`; return the Call."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "put":
+        recv = node.func.value
+        if isinstance(recv, ast.Attribute) and recv.attr == "out":
+            return node
+    return None
+
+
+def _dict_has_error_key(d: ast.Dict) -> bool:
+    return any(isinstance(k, ast.Constant) and k.value == "error"
+               for k in d.keys)
+
+
+def _error_dict_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict) \
+                and _dict_has_error_key(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _classify_puts(fn: ast.AST):
+    sentinels: List[ast.Call] = []
+    errors: List[ast.Call] = []
+    err_names = _error_dict_names(fn)
+    for node in ast.walk(fn):
+        call = _is_out_put(node)
+        if call is None or not call.args:
+            continue
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            sentinels.append(call)
+        elif isinstance(arg, ast.Dict) and _dict_has_error_key(arg):
+            errors.append(call)
+        elif isinstance(arg, ast.Name) and arg.id in err_names:
+            errors.append(call)
+    return sentinels, errors
+
+
+def _self_calls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
+def run(files: List[SourceFile], ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        attach_parents(sf.tree)
+        for cls in [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]:
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            puts = {name: _classify_puts(fn) for name, fn in methods.items()}
+            sentinel_methods = [n for n, (s, _) in puts.items() if s]
+            if not sentinel_methods:
+                continue  # not a finalizer class
+
+            # the designated completer: prefer a method named *complete*
+            completer = next((n for n in sentinel_methods if "complete" in n),
+                             sentinel_methods[0])
+
+            # finalizers: methods that transitively reach the completer
+            finalizers: Set[str] = {completer}
+            changed = True
+            while changed:
+                changed = False
+                for name, fn in methods.items():
+                    if name in finalizers:
+                        continue
+                    if _self_calls(fn) & finalizers:
+                        finalizers.add(name)
+                        changed = True
+
+            for name, fn in methods.items():
+                sentinels, errors = puts[name]
+                # O1: rogue sentinel outside the completer
+                if name != completer:
+                    for call in sentinels:
+                        if allowed(sf, RULE, call.lineno, fn.lineno):
+                            continue
+                        findings.append(make_finding(
+                            sf, RULE, call.lineno,
+                            f"None sentinel put outside the designated "
+                            f"completer '{completer}' — risks a double or "
+                            "premature end-of-stream",
+                            f"route termination through self.{completer}()",
+                            f"{cls.name}.{name}"))
+                # O2: error item without a path to the sentinel
+                if errors and name not in finalizers:
+                    for call in errors:
+                        if allowed(sf, RULE, call.lineno, fn.lineno):
+                            continue
+                        findings.append(make_finding(
+                            sf, RULE, call.lineno,
+                            "typed error item emitted but this method never "
+                            f"reaches the completer '{completer}' — the "
+                            "waiter hangs waiting for its sentinel",
+                            f"call self.{completer}() after putting the "
+                            "error item",
+                            f"{cls.name}.{name}"))
+
+            # O3: broad except handlers must finalize or re-raise
+            for name, fn in methods.items():
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.ExceptHandler):
+                        continue
+                    broad = node.type is None or (
+                        isinstance(node.type, ast.Name)
+                        and node.type.id == "Exception")
+                    if not broad:
+                        continue
+                    body_calls: Set[str] = set()
+                    has_raise = False
+                    for n in ast.walk(node):
+                        if isinstance(n, ast.Raise):
+                            has_raise = True
+                        c = n if isinstance(n, ast.Call) else None
+                        if c is not None and isinstance(c.func, ast.Attribute) \
+                                and isinstance(c.func.value, ast.Name) \
+                                and c.func.value.id == "self":
+                            body_calls.add(c.func.attr)
+                    if has_raise or (body_calls & finalizers):
+                        continue
+                    if allowed(sf, RULE, node.lineno, fn.lineno):
+                        continue
+                    findings.append(make_finding(
+                        sf, RULE, node.lineno,
+                        "broad except swallows the failure without "
+                        "finalizing — every in-flight request leaks "
+                        "(waiters hang)",
+                        f"call a finalizer ({', '.join(sorted(finalizers))}) "
+                        "or re-raise",
+                        f"{cls.name}.{name}"))
+    return findings

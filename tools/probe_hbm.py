@@ -86,8 +86,8 @@ def paged_pool_report():
     snap = eng.stats.snapshot()
     bs = eng._kv_block
     owned = written = 0
-    for req in eng._slots:
-        if req is None or req.finished:
+    for req in eng.live_requests():
+        if req.finished:
             continue
         owned += len(req.block_ids) * bs
         written += len(req.tokens) + req.n_generated

@@ -63,7 +63,7 @@ def probe(params, cfg, slots: int, paged: bool = False) -> None:
         chunk1 = eng._jit_chunks_paged[1]
         import jax.numpy as jnp
 
-        table = jnp.asarray(eng._table_host)
+        table = jnp.asarray(eng.table_host_snapshot())
 
         def step(state):
             s2, _, _, _ = chunk1(params, state, table)
