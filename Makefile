@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos
+.PHONY: proto test test-e2e tier1 lint sanitize bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -11,11 +11,23 @@ native:
 	$(MAKE) -C native
 
 # Static invariants (docs/operations.md "Static invariants: graftlint"):
-# hot-sync, lock-guard, retrace, outcome, env-knob vs the checked-in
-# baseline, plus a bytecode-compile sweep of the serving + tools trees.
+# hot-sync, lock-guard, lockorder, retrace, outcome, env-knob vs the
+# checked-in baseline, plus a bytecode-compile sweep of the serving +
+# tools trees.
 lint:
 	python -m tools.graftlint
 	python -m compileall -q seldon_tpu tools
+
+# Dynamic half of the concurrency contract (docs/operations.md "Dynamic
+# sanitizer: graftsan"): the engine-facing tier-1 subset re-run under
+# GRAFTSAN=1 — order-asserting lock proxies, boundary refcount/slot
+# audits, terminal-item enforcement, seeded interleaving perturbation.
+sanitize:
+	env JAX_PLATFORMS=cpu GRAFTSAN=1 GRAFTSAN_SEED=$${GRAFTSAN_SEED:-0} \
+	  python -m pytest tests/test_graftsan.py tests/test_lifecycle.py \
+	  tests/test_chaos.py tests/test_paged_kv.py \
+	  tests/test_chunked_prefill.py tests/test_prefix_cache.py \
+	  -x -q -m "not slow"
 
 test:
 	python -m pytest tests/ -x -q -m "not e2e"
@@ -49,13 +61,21 @@ fuzz-chaos:
 	env JAX_PLATFORMS=cpu FUZZ_EXAMPLES=1000 CHAOS_SEED=$${CHAOS_SEED:-0} \
 	  python -m pytest tests/test_chaos.py -q -m fuzz
 
+# Long-haul graftsan soak: >=200 mixed dense/paged/chunked requests per
+# run under the sanitizer. GRAFTSAN_SEED replays an interleaving
+# schedule; FUZZ_EXAMPLES scales the request count (split across modes).
+fuzz-graftsan:
+	env JAX_PLATFORMS=cpu GRAFTSAN_SEED=$${GRAFTSAN_SEED:-0} \
+	  FUZZ_EXAMPLES=$${FUZZ_EXAMPLES:-600} \
+	  python -m pytest tests/test_graftsan.py -q -m fuzz
+
 bench:
 	python bench.py
 
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e
+ci: lint test test-e2e sanitize
 
 native-tsan:
 	$(MAKE) -C native tsan

@@ -839,8 +839,8 @@ def _measure_paged(params, cfg) -> dict:
 
         def watch():  # occupancy gauge: live (unfinished) slots
             while not done.is_set():
-                n = sum(1 for r in engine._slots
-                        if r is not None and not r.finished)
+                n = sum(1 for r in engine.live_requests()
+                        if not r.finished)
                 peak[0] = max(peak[0], n)
                 time.sleep(0.001)
 

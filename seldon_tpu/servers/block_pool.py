@@ -109,6 +109,13 @@ class BlockAllocator:
         with self._lock:
             return sum(1 for c in self._refs.values() if c > 1)
 
+    def refs_snapshot(self) -> Dict[int, int]:
+        """Copy of the live refcount table (block id -> count), for the
+        graftsan boundary audit: every ref must be accounted for by a
+        live request's block table or a prefix-trie pin."""
+        with self._lock:
+            return dict(self._refs)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             live = len(self._refs)

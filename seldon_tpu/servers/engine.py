@@ -41,6 +41,7 @@ import numpy as np
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
+from seldon_tpu.servers import graftsan
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -691,6 +692,11 @@ class InferenceEngine:
         self._jit_deactivate = jax.jit(
             self._deactivate_impl, donate_argnums=(0,)
         )
+        # Runtime concurrency sanitizer (GRAFTSAN=1; None — and zero
+        # hot-path code — otherwise). Wraps every lock above in an
+        # order-asserting proxy, so this must stay the LAST piece of
+        # engine state __init__ builds.
+        self._san = graftsan.instrument(self)
 
     def _fresh_state(self) -> Dict[str, Any]:
         B, Smax = self.ecfg.max_slots, self.ecfg.max_seq_len
@@ -1271,7 +1277,11 @@ class InferenceEngine:
                 f"retry with backoff"
             )
         now = time.perf_counter()
-        req = _Request(0, list(tokens), params, queue.Queue(), now)
+        out_q = (
+            queue.Queue() if self._san is None
+            else graftsan.TerminalQueue(self._san)
+        )
+        req = _Request(0, list(tokens), params, out_q, now)
         ttl_ms = params.deadline_ms or self.ecfg.default_deadline_ms
         if ttl_ms:
             req.deadline = now + ttl_ms / 1000.0
@@ -1545,7 +1555,7 @@ class InferenceEngine:
             # lattice instead, plus the per-width prefix seed scatters.
             n_chunk_warm = self._warmup_chunked(sizes)
             for n in self._chunk_sizes:
-                self._state, _, _, _ = self._dispatch_decode_chunk(n)
+                self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
             if self._paged:
                 self._state = self._jit_cow(
                     self._state, jnp.int32(0), jnp.int32(0)
@@ -1591,7 +1601,7 @@ class InferenceEngine:
                 self._state, jnp.int32(0), jnp.int32(0)
             )
             for n in self._chunk_sizes:
-                self._state, _, _, _ = self._dispatch_decode_chunk(n)
+                self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
             jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
             logger.info(
                 "engine warmed (paged): %d admission variants + %d decode "
@@ -1644,7 +1654,7 @@ class InferenceEngine:
         # All slots inactive: pure compile + masked no-op writes, one per
         # chunk-ladder rung.
         for n in self._chunk_sizes:
-            self._state, _, _, _ = self._dispatch_decode_chunk(n)
+            self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
         jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
         logger.info(
             "engine warmed: %d admission variants (+%d prefix-warm) + %d "
@@ -2522,13 +2532,17 @@ class InferenceEngine:
         thread — warmup and direct test calls share the dispatch helpers
         and must neither fault nor consume draws (the seeded fault
         sequence is defined over scheduler-loop dispatches alone)."""
+        if self._san is not None and (
+            threading.current_thread() is self._thread
+        ):
+            self._san.perturb("dispatch")
         if self._chaos is not None and (
             threading.current_thread() is self._thread
         ):
             self._chaos.on_dispatch(site)
 
-    def _fail_req(self, req: _Request, msg: str, kind: str = "internal",
-                  retriable: bool = False) -> None:
+    def _fail_req(self, req: _Request, msg: str,  # graftlint: holds(_book)
+                  kind: str = "internal", retriable: bool = False) -> None:
         """Fail one request with a typed error item (kind in {internal,
         capacity, preempted, cancelled, deadline, draining, shutdown}),
         then finalize it — slot/blocks/trie refs freed, None sentinel
@@ -2541,6 +2555,8 @@ class InferenceEngine:
     def _complete(self, req: _Request) -> None:  # graftlint: holds(_book)
         """Finish a request (idempotent) and free its slot unless the
         slot has already been recycled to a newer request."""
+        if self._san is not None:
+            self._san.assert_holds("_book")
         if req.finished:
             return
         req.finished = True
@@ -2570,6 +2586,8 @@ class InferenceEngine:
         when a dispatched computation errored (donated buffers are gone).
         `pendings`: in-flight (admits, handles, roster) tuples — requests
         optimistically recycled out of `_slots` live only there."""
+        if self._san is not None:
+            self._san.assert_holds("_book")
         live: Dict[int, _Request] = {}
         for req in self._slots:
             if req is not None:
@@ -2618,14 +2636,17 @@ class InferenceEngine:
                 req.prefix_handle = None
                 req.prefix_len = None
                 req.block_ids = []
+            if self._san is not None:
+                # Fresh allocator/trie carry fresh raw locks.
+                graftsan.rewrap_pool(self, self._san)
         self._state = self._fresh_state()
 
     def _process_boundary(self, admits, chunk_handles, roster) -> None:  # graftlint: holds(_book)
         """Fetch one boundary's device results (one parallel transfer) and
         run host bookkeeping."""
         if self._chaos is not None:
-            self._chaos.maybe_slow_boundary()
-        admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
+            self._chaos.maybe_slow_boundary()  # graftlint: allow(lock-block) deliberate chaos fault: a slow boundary under _book is exactly the race window being tested
+        admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync, lock-block) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
             (
                 [(f, d) for _, _, f, d in admits],
                 chunk_handles,
@@ -2634,6 +2655,8 @@ class InferenceEngine:
         self._process_admits(admits, admit_data)
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
+        if self._san is not None:
+            self._san.audit(self)
 
     def _roster(self) -> List[Optional[_Request]]:  # graftlint: holds(_book)
         """Slot -> request snapshot for THIS wave's decode chunk. Mid-
@@ -2731,6 +2754,8 @@ class InferenceEngine:
                 return
             admits, chunk_handles, roster = item
             try:
+                if self._san is not None:
+                    self._san.perturb("boundary")
                 if self._chaos is not None:
                     self._chaos.maybe_slow_boundary()
                 admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
@@ -2740,6 +2765,8 @@ class InferenceEngine:
                     self._process_admits(admits, admit_data)
                     if chunk_data is not None:
                         self._process_chunk(*chunk_data, roster)
+                    if self._san is not None:
+                        self._san.audit(self)
             except Exception as e:
                 logger.exception("boundary fetch failed")
                 self._drain_and_fail(str(e), current=item)
@@ -2788,6 +2815,8 @@ class InferenceEngine:
         byte-identical. A request already recycled out of _slots is
         within decode_chunk tokens of its budget and is left to retire
         naturally (its waiter already has every token it will get)."""
+        if self._san is not None:
+            self._san.perturb("reap")
         if self._chaos is not None:
             rids = [
                 r.rid for r in self._slots

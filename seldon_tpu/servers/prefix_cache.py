@@ -249,7 +249,8 @@ class PagedPrefixIndex:
     a byte budget — retained prefixes occupy blocks the pool could not
     otherwise use only while it has them spare."""
 
-    def __init__(self, block: int, kv_block: int, allocator):
+    def __init__(self, block: int, kv_block: int,
+                 allocator: "BlockAllocator"):
         if kv_block % block:
             raise ValueError(
                 f"kv_block ({kv_block}) must be a multiple of the prefix "
@@ -341,6 +342,20 @@ class PagedPrefixIndex:
                     child.refs += 1
                     handle.nodes.append(child)
                 node = child
+
+    def block_refs(self) -> Dict[int, int]:
+        """Pool block id -> number of trie nodes holding a ref on it
+        (several consecutive prefix-block nodes can share one kv_block).
+        Graftsan's boundary audit sums this with live request tables to
+        reconcile the allocator's refcounts."""
+        out: Dict[int, int] = {}
+        with self._lock:
+            stack = list(self._root.children.values())
+            while stack:
+                nd = stack.pop()
+                out[nd.block] = out.get(nd.block, 0) + 1
+                stack.extend(nd.children.values())
+        return out
 
     # --- eviction -----------------------------------------------------------
 

@@ -143,10 +143,10 @@ def test_decode_dispatched_between_prefill_chunks():
     def step():
         with eng._book:
             work = eng._dispatch_once()
-        if work is None:
-            return False, False
-        mid = bool(eng._prefilling)  # a request still has chunks to go
-        eng._process_boundary(*work)
+            if work is None:
+                return False, False
+            mid = bool(eng._prefilling)  # request still has chunks to go
+            eng._process_boundary(*work)  # holds(_book), like the loop
         return True, mid
 
     q_short = eng.submit(

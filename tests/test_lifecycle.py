@@ -330,10 +330,27 @@ def server():
 
 
 def test_jaxserver_deadline_via_request_dict(server):
-    with pytest.raises(RuntimeError, match="deadline"):
-        # TTL already lapsed at the first boundary: kind == deadline.
-        server.generate({"prompt": "hi", "max_new_tokens": 4,
-                         "temperature": 0.0, "deadline_ms": 1})
+    # Hold the bookkeeping lock while the 1 ms TTL lapses: the scheduler
+    # cannot drain/admit the request until we release, so the queued-
+    # deadline path fires deterministically (a free-running scheduler can
+    # race the TTL and legitimately finish 4 tokens first).
+    result = {}
+
+    def call():
+        try:
+            server.generate({"prompt": "hi", "max_new_tokens": 4,
+                             "temperature": 0.0, "deadline_ms": 1})
+            result["ok"] = True
+        except RuntimeError as e:
+            result["err"] = e
+
+    with server.engine._book:
+        th = threading.Thread(target=call)
+        th.start()
+        time.sleep(0.05)  # TTL lapses while the request sits queued
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert "deadline" in str(result.get("err")), result
 
 
 def test_jaxserver_stream_close_cancels_engine_request(server):

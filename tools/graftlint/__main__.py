@@ -10,35 +10,55 @@ import sys
 from pathlib import Path
 from typing import List
 
-from . import hotpath, knobs, locks, outcome, retrace
-from .core import (Context, Finding, load_baseline, load_tree, run_passes,
-                   write_baseline)
+from . import hotpath, knobs, lockorder, locks, outcome, retrace
+from .core import (Context, Finding, PLACEHOLDER_NOTE, load_baseline,
+                   load_tree, run_passes, write_baseline)
 
-PASSES = [hotpath.run, locks.run, retrace.run, outcome.run, knobs.run]
+PASSES = [hotpath.run, locks.run, lockorder.run, retrace.run, outcome.run,
+          knobs.run]
 
 
 def _repo_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
+def default_targets(root: Path) -> List[Path]:
+    """The trees and top-level entry points CI lints. bench.py,
+    bench_orchestrator.py and __graft_entry__.py are single files, not
+    packages, so a bare directory list used to let them escape every
+    pass."""
+    return [root / "seldon_tpu", root / "tools", root / "bench.py",
+            root / "bench_orchestrator.py", root / "__graft_entry__.py"]
+
+
 def main(argv: List[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="seldon-tpu invariant checker (hot-sync, lock-guard, "
-                    "retrace, outcome, env-knob)")
+                    "lockorder, retrace, outcome, env-knob)")
     ap.add_argument("paths", nargs="*", default=[],
-                    help="files/dirs to lint (default: seldon_tpu tools)")
+                    help="files/dirs to lint (default: seldon_tpu tools "
+                         "bench.py bench_orchestrator.py "
+                         "__graft_entry__.py)")
     ap.add_argument("--write-baseline", action="store_true",
-                    help="accept all current findings into the baseline")
+                    help="accept all current findings into the baseline "
+                         "(requires --note)")
+    ap.add_argument("--note", default=None, metavar="REASON",
+                    help="justification stamped on new baseline entries; "
+                         "required with --write-baseline")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report findings without baseline suppression")
     ap.add_argument("--gen-knobs", action="store_true",
                     help="regenerate docs/knobs.md and exit")
     args = ap.parse_args(argv)
 
+    if args.write_baseline and not (args.note and args.note.strip()):
+        ap.error("--write-baseline requires --note \"<reason>\" — every "
+                 "suppression must say why it is safe to keep")
+
     root = _repo_root()
     targets = [Path(p).resolve() for p in args.paths] or \
-        [root / "seldon_tpu", root / "tools"]
+        default_targets(root)
     for t in targets:
         if not t.exists():
             print(f"graftlint: no such path: {t}", file=sys.stderr)
@@ -58,10 +78,18 @@ def main(argv: List[str] | None = None) -> int:
 
     baseline = {} if args.no_baseline else load_baseline(ctx.baseline_path)
     if args.write_baseline:
-        write_baseline(ctx.baseline_path, findings, baseline)
+        write_baseline(ctx.baseline_path, findings, baseline,
+                       note=args.note.strip())
         print(f"graftlint: baselined {len(findings)} finding(s) -> "
               f"{ctx.baseline_path.name}")
         return 0
+
+    for fp, e in sorted(baseline.items()):
+        if e.get("note", PLACEHOLDER_NOTE) == PLACEHOLDER_NOTE:
+            print(f"graftlint: warning: baseline entry {fp} "
+                  f"({e.get('rule')} in {e.get('file')}) has a "
+                  f"placeholder note — rerun --write-baseline with "
+                  f"--note \"<reason>\"", file=sys.stderr)
 
     fresh: List[Finding] = []
     used = set()

@@ -9,8 +9,10 @@ Groups in ``EXTERNAL_GROUPS`` are exempt from the stale-entry check: the
 value is owned by the platform (JAX, the kubelet, cloud SDKs) so a knob
 may stay registered even when no scanned file currently reads it.
 
-Bench-harness phase knobs (``BENCH_*``) are documented in
-``docs/benchmarking.md``; ``bench.py`` lives outside the linted tree.
+``bench.py`` / ``bench_orchestrator.py`` are part of the linted tree, so
+the bench-harness phase knobs (``BENCH_*`` / ``BENCH_ORCH_*``) are
+registered here like everything else; the measurement methodology behind
+them stays in ``docs/benchmarking.md``.
 """
 
 EXTERNAL_GROUPS = {"platform"}
@@ -68,6 +70,17 @@ KNOBS = {
     "CHAOS_SLOW_MS": _k("chaos", "5", "Delay for a slow boundary, ms."),
     "CHAOS_DISCONNECT": _k("chaos", "0", "Probability a client disconnect "
                            "is injected (stream close -> cancel)."),
+
+    # --- runtime concurrency sanitizer (servers/graftsan.py) --------------
+    "GRAFTSAN": _k("sanitizer", "0",
+                   "Enable the runtime concurrency sanitizer: "
+                   "order-asserting lock proxies, boundary refcount "
+                   "audits, terminal-item enforcement (`make sanitize`). "
+                   "Env-only by design; zero overhead when unset."),
+    "GRAFTSAN_SEED": _k("sanitizer", "0",
+                        "Seed for the sanitizer's interleaving explorer; "
+                        "a fixed seed replays the same perturbation "
+                        "sequence."),
 
     # --- runtime microservice / persistence / tracing ---------------------
     "API_TYPE": _k("runtime", "REST,GRPC", "Transports to serve."),
@@ -190,9 +203,101 @@ KNOBS = {
     "CH_CANCEL_FRAC": _k("bench-tools", "0.1", "Fraction of chaos probe "
                          "requests cancelled mid-flight."),
 
+    # --- bench harness (bench.py / bench_orchestrator.py) -----------------
+    "BENCH_PRESET": _k("bench-harness", "llama3-8b",
+                       "Model preset for the headline bench run "
+                       "(`tiny` = CPU smoke)."),
+    "BENCH_SLOTS": _k("bench-harness", "0 (192 for llama3-8b, else 160)",
+                      "Decode batch slots; 0 picks the measured per-preset "
+                      "knee."),
+    "BENCH_NREQ": _k("bench-harness", "0 (2x slots)",
+                     "Requests in the throughput phase."),
+    "BENCH_ADMIT": _k("bench-harness", "0 (16 for llama3-8b, else 8)",
+                      "Max admissions per scheduler step."),
+    "BENCH_PROMPT": _k("bench-harness", "128", "Prompt length in tokens."),
+    "BENCH_NEW": _k("bench-harness", "128", "New tokens per request."),
+    "BENCH_CHUNK": _k("bench-harness", "64", "Decode dispatch chunk."),
+    "BENCH_KV": _k("bench-harness", "int8", "KV cache dtype."),
+    "BENCH_ATTN": _k("bench-harness", "(model default)",
+                     "Attention kernel override."),
+    "BENCH_WEIGHTS": _k("bench-harness", "int8",
+                        "Weight dtype (`bf16` reverts weight-only int8)."),
+    "BENCH_ACT": _k("bench-harness", "int8",
+                    "W8A8 matmul activation dtype (`bf16` reverts)."),
+    "BENCH_PREFIX": _k("bench-harness", "0",
+                       "Run the shared-prefix cache phase."),
+    "BENCH_PREFIX_BLOCK": _k("bench-harness", "16",
+                             "Prefix phase trie block size."),
+    "BENCH_PREFIX_NREQ": _k("bench-harness", "24",
+                            "Prefix phase request count."),
+    "BENCH_CHUNKED": _k("bench-harness", "0",
+                        "Run the chunked-prefill interference phase."),
+    "BENCH_CHUNKED_STREAMS": _k("bench-harness", "6",
+                                "Chunked phase concurrent decode streams."),
+    "BENCH_CHUNKED_LONG_X": _k("bench-harness", "8",
+                               "Chunked phase interloper prompt length, as "
+                               "a multiple of BENCH_PROMPT."),
+    "BENCH_PAGED": _k("bench-harness", "0",
+                      "Run the paged-vs-dense fixed-HBM phase."),
+    "BENCH_PAGED_DENSE_SLOTS": _k("bench-harness", "4",
+                                  "Dense-slab slot count the paged phase "
+                                  "compares against."),
+    "BENCH_PAGED_KV_BLOCK": _k("bench-harness", "16",
+                               "Paged phase KV block size."),
+    "BENCH_SLO": _k("bench-harness", "1 for bench-1b, else 0",
+                    "Run the TTFT SLO search phase."),
+    "BENCH_SLO_CHUNK": _k("bench-harness", "0 (adaptive)",
+                          "Pin a fixed dispatch chunk for the SLO search "
+                          "instead of occupancy-adaptive chunking."),
+    "BENCH_SECOND_PRESET": _k("bench-harness",
+                              "bench-1b for llama3-8b, else (empty)",
+                              "Trailing deployment-proxy preset; empty "
+                              "disables the second phase."),
+    "BENCH_SECOND_SLOTS": _k("bench-harness", "0 (160)",
+                             "Slots for the trailing preset run."),
+    "BENCH_SECOND_SLO": _k("bench-harness", "1",
+                           "Run the SLO search in the trailing phase."),
+    "BENCH_BACKEND_WAIT": _k("bench-harness", "900",
+                             "Seconds the supervisor polls TPU bring-up "
+                             "before giving up (tunneled-rig outage "
+                             "proofing)."),
+    "BENCH_ATTEMPT_TIMEOUT": _k("bench-harness", "4500",
+                                "Per-attempt wall clock for the measurement "
+                                "child process."),
+    "BENCH_ATTEMPTS": _k("bench-harness", "2",
+                         "Measurement child retry budget."),
+    "BENCH_REQUIRE_TPU": _k("bench-harness",
+                            "0 when JAX_PLATFORMS=cpu, else 1",
+                            "Whether a cpu-only backend fails the bring-up "
+                            "probe."),
+    "_BENCH_CHILD": _k("bench-harness", "(set by the supervisor)",
+                       "Internal parent->child marker; `1` makes bench.py "
+                       "run the measurement instead of supervising."),
+    "BENCH_ORCH_CLIENTS": _k("bench-harness", "32",
+                             "Orchestrator bench concurrent clients."),
+    "BENCH_ORCH_CLIENT_PROCS": _k("bench-harness", "2",
+                                  "Client processes generating load."),
+    "BENCH_ORCH_SECONDS": _k("bench-harness", "12",
+                             "Measurement window per configuration."),
+    "BENCH_ORCH_REPEATS": _k("bench-harness", "3",
+                             "Repeats per configuration (best kept)."),
+    "BENCH_ORCH_TRANSPORTS": _k("bench-harness", "rest,grpc",
+                                "Transports to sweep."),
+    "BENCH_ORCH_PAYLOADS": _k("bench-harness", "ndarray,dense",
+                              "Payload shapes to sweep."),
+    "BENCH_ORCH_GRAPHS": _k("bench-harness", "inproc,netunit",
+                            "Graph topologies to sweep (in-process stub vs "
+                            "real microservice subprocess)."),
+    "BENCH_ORCH_FAST": _k("bench-harness", "1",
+                          "Expose the framed-proto fast lane on port+1; "
+                          "`0` pins the hop to full gRPC for A/B."),
+
     # --- platform (owned by JAX / Kubernetes / cloud SDKs) ----------------
     "JAX_PLATFORMS": _k("platform", "(auto)", "JAX backend selection; "
                         "`cpu` pins tests and probes off the TPU."),
+    "XLA_FLAGS": _k("platform", "(unset)", "XLA compiler flags; the entry "
+                    "shim appends host-platform device-count flags for "
+                    "CPU smoke runs."),
     "KUBERNETES_SERVICE_HOST": _k("platform", "kubernetes.default.svc",
                                   "In-cluster API host (set by the "
                                   "kubelet)."),

@@ -5,20 +5,26 @@ MAX_QUEUE, CHAOS, ...).  An undocumented knob is an operational landmine:
 it changes production behaviour and appears in no runbook.  This pass
 keeps ``docs/knobs.md`` honest by construction:
 
-  K1  an ``os.environ`` / ``os.getenv`` read whose name is not registered
-      in ``tools/graftlint/knob_registry.py``
-  K2  a registered knob no scanned file reads (stale registry entry) —
-      groups listed in ``EXTERNAL_GROUPS`` are exempt (read by JAX, the
-      kubelet, cloud SDKs, tests, ...)
-  K3  ``docs/knobs.md`` differs from the generated table — regenerate
-      with ``python -m tools.graftlint --gen-knobs``
+  K1  [``env-knob``] an ``os.environ`` / ``os.getenv`` read whose name
+      is not registered in ``tools/graftlint/knob_registry.py``
+  K2  [``env-knob-dead``] a registered knob no scanned file reads — a
+      dead knob is worse than an unregistered one, because
+      ``docs/knobs.md`` keeps advertising a control the code no longer
+      honors.  Groups listed in ``EXTERNAL_GROUPS`` are exempt (read by
+      JAX, the kubelet, cloud SDKs, tests, ...)
+  K3  [``env-knob``] ``docs/knobs.md`` differs from the generated
+      table — regenerate with ``python -m tools.graftlint --gen-knobs``
 
 Name resolution handles string literals, module-level string constants
 (``ENV_FOO = "FOO"; os.environ.get(ENV_FOO)``), function parameter
-defaults resolving to either, and local aliases of ``os.environ``.
-Reads through genuinely dynamic names are skipped.  Writes are skipped.
+defaults resolving to either, and local aliases of ``os.environ``
+(direct rebinds only — a *value* read out of environ, like
+``flags = os.environ.get("XLA_FLAGS", "")``, is not the mapping and
+substring tests against it are not env reads).  Reads through genuinely
+dynamic names are skipped.  Writes are skipped.
 
-Waive with ``# graftlint: allow(env-knob) why``.
+Waive with ``# graftlint: allow(env-knob) why`` (or ``env-knob-dead``
+on the registry line).
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ from .core import (Context, Finding, SourceFile, allowed, attach_parents,
 from .knob_registry import EXTERNAL_GROUPS, KNOBS
 
 RULE = "env-knob"
+RULE_DEAD = "env-knob-dead"
 
 REGISTRY_REL = "tools/graftlint/knob_registry.py"
 
@@ -83,20 +90,29 @@ def _os_names(tree: ast.Module) -> Set[str]:
 
 
 def _environ_aliases(tree: ast.Module, os_names: Set[str]) -> Set[str]:
-    """Names assigned from os.environ anywhere in the file, including
-    `env = environ if environ is not None else os.environ`."""
-
-    def mentions_environ(e: ast.AST) -> bool:
-        return any(isinstance(n, ast.Attribute) and n.attr == "environ"
-                   and isinstance(n.value, ast.Name) and n.value.id in os_names
-                   for n in ast.walk(e))
+    """Names rebound to the os.environ MAPPING itself, including
+    `env = environ if environ is not None else os.environ`.  A value
+    merely derived from environ (`flags = os.environ.get(...)`) is NOT
+    an alias — `"x" in flags` is a substring test, not an env read."""
 
     aliases: Set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and mentions_environ(node.value):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    aliases.add(t.id)
+
+    def is_environ_expr(e: ast.AST) -> bool:
+        if isinstance(e, ast.Attribute) and e.attr == "environ" \
+                and isinstance(e.value, ast.Name) and e.value.id in os_names:
+            return True
+        if isinstance(e, ast.Name) and e.id in aliases:
+            return True
+        if isinstance(e, ast.IfExp):
+            return is_environ_expr(e.body) or is_environ_expr(e.orelse)
+        return False
+
+    for _ in range(2):  # second pass resolves alias-of-alias chains
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and is_environ_expr(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
     return aliases
 
 
@@ -165,7 +181,7 @@ def generate_knobs_md(reads: List[Tuple[str, SourceFile, int, str]]) -> str:
         "Every environment variable the serving tree reads, kept in sync with",
         "the code by graftlint's env-knob pass (an unregistered read fails",
         "`make lint`).  Registry: `tools/graftlint/knob_registry.py`.",
-        "Bench-harness phase knobs (`BENCH_*`) live in",
+        "Bench-harness methodology behind the `BENCH_*` knobs lives in",
         "[benchmarking.md](benchmarking.md).",
         "",
     ]
@@ -208,16 +224,22 @@ def run(files: List[SourceFile], ctx: Context) -> List[Finding]:
     if reg_sf is None:
         return findings
 
-    # K2: stale registry entries
+    # K2: dead knobs — registered (and so advertised by docs/knobs.md)
+    # but read nowhere in the scanned tree.
     for name, meta in KNOBS.items():
         if name in seen or meta["group"] in EXTERNAL_GROUPS:
             continue
         decl_line = next((i for i, t in enumerate(reg_sf.lines, 1)
                           if f'"{name}"' in t), 1)
+        if allowed(reg_sf, RULE_DEAD, decl_line):
+            continue
         findings.append(make_finding(
-            reg_sf, RULE, decl_line,
-            f"registered knob '{name}' is read by no scanned file",
-            "remove the stale entry or mark its group external",
+            reg_sf, RULE_DEAD, decl_line,
+            f"dead knob: '{name}' is registered (and advertised in "
+            "docs/knobs.md) but read by no scanned file",
+            "delete the stale entry and regenerate with --gen-knobs, or "
+            "move the knob to an EXTERNAL_GROUPS group if a platform "
+            "component reads it",
             name))
 
     # K3: docs/knobs.md freshness

@@ -1,0 +1,533 @@
+"""lockorder pass: interprocedural lock-order, holds() call sites, and
+blocking-while-locked.
+
+The lock-guard pass checks that guarded *fields* are touched under the
+right lock; this pass checks the *locks themselves* compose.  It builds
+the interprocedural lock-acquisition graph from three sources — lexical
+``with self.<lock>:`` nesting, ``# graftlint: holds(<lock>)``
+annotations (which seed the held set of the annotated method), and the
+call graph (same ``self.<m>()`` resolution as hotpath.py, extended
+across classes through attribute bindings like ``self.stats =
+EngineStats()``) — and enforces three rules against the canonical order
+in ``seldon_tpu/servers/lock_order.py``:
+
+  holds-site   every call site of a ``holds(X)``-annotated method must
+               itself be in an X-held context (lexical ``with``, its own
+               holds() annotation, or ``__init__`` pre-publication).
+               A holds() annotation that is a lie at a call site is a
+               data race the lock-guard pass can no longer see.
+
+  lock-order   every acquired-before edge (direct or through a callee)
+               must respect the documented rank/leaf table; acquiring a
+               held non-reentrant lock is a self-deadlock; any cycle in
+               the derived graph — including among locks the table does
+               not rank — is a deadlock between two threads.
+
+  lock-block   no blocking call while the scheduler lock ``_book`` is
+               held: ``time.sleep``, blocking ``Queue.get``/bounded
+               ``Queue.put``, ``jax.device_get``, ``block_until_ready``,
+               ``.join()``.  A stalled ``_book`` freezes admission,
+               cancel, metrics, and drain all at once.
+
+Lock identity: a lock attribute assigned ``threading.Lock()`` /
+``RLock()`` in class C is canonicalized through
+``lock_order.canonical_name(C, attr)`` so the same physical lock has one
+name on every path (``self.stats.lock`` in the engine and ``self.lock``
+inside EngineStats are both ``stats.lock``).  Cross-class paths resolve
+through attribute bindings (``self.attr = ClassName(...)``, or a
+class-annotated ctor parameter) and simple local aliases
+(``x = self.attr``).  Unresolvable receivers are skipped — this pass is
+deliberately under-approximate; the graftsan runtime witness covers the
+dynamic remainder.
+
+Waive a deliberate edge/stall with ``# graftlint: allow(<rule>) why`` on
+the acquisition/call line; waived lines also drop out of callee
+summaries so callers are not re-flagged for them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from seldon_tpu.servers.lock_order import canonical_name, edge_violation
+
+from .core import (Context, Finding, SourceFile, allowed, attach_parents,
+                   make_finding)
+
+RULE_HOLDS = "holds-site"
+RULE_ORDER = "lock-order"
+RULE_BLOCK = "lock-block"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    sf: SourceFile
+    node: ast.ClassDef
+    locks: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # lock attr -> reentrant (RLock)
+    queues: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # queue attr -> bounded
+    bindings: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    # attr -> class names it may hold
+    methods: Dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+    holds: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # method name -> lock attr from `# graftlint: holds(<lock>)`
+
+
+_Site = Tuple[SourceFile, int, str]  # file, line, qualname
+
+
+def _is_lock_ctor(expr: ast.AST) -> Optional[bool]:
+    """None if not a lock constructor, else reentrancy (RLock -> True)."""
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LOCK_CTORS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "threading"):
+            return node.func.attr == "RLock"
+    return None
+
+
+def _is_queue_ctor(expr: ast.AST) -> Optional[bool]:
+    """None if not a Queue constructor, else boundedness (any maxsize)."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    named = (isinstance(f, ast.Attribute) and f.attr == "Queue") or \
+        (isinstance(f, ast.Name) and f.id == "Queue")
+    if not named:
+        return None
+    return bool(expr.args) or any(k.arg == "maxsize" for k in expr.keywords)
+
+
+def _ctor_class(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+        return expr.func.id
+    return None
+
+
+def _ann_class(ann: Optional[ast.AST]) -> Optional[str]:
+    """Class name from a parameter annotation (Name or string literal)."""
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip("'\" ").split(".")[-1] or None
+    return None
+
+
+def _iter_own(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class scopes
+    (their bodies run at some other time, under some other held set)."""
+    work = list(ast.iter_child_nodes(node))
+    while work:
+        n = work.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        work.extend(ast.iter_child_nodes(n))
+
+
+def _collect_classes(files: List[SourceFile]) -> Dict[str, _ClassInfo]:
+    classes: Dict[str, _ClassInfo] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = _ClassInfo(node.name, sf, node)
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[m.name] = m
+                    lock = sf.holds.get(m.lineno)
+                    if lock:
+                        ci.holds[m.name] = lock
+            init = ci.methods.get("__init__")
+            params: Dict[str, Optional[str]] = {}
+            if init is not None:
+                for a in init.args.args + init.args.kwonlyargs:
+                    params[a.arg] = _ann_class(a.annotation)
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign):
+                    targets, value = n.targets, n.value
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    targets, value = [n.target], n.value
+                else:
+                    continue
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    attr = t.attr
+                    reent = _is_lock_ctor(value)
+                    if reent is not None:
+                        ci.locks[attr] = reent
+                        continue
+                    bounded = _is_queue_ctor(value)
+                    if bounded is not None:
+                        ci.queues[attr] = bounded
+                        continue
+                    for v in ([value.body, value.orelse]
+                              if isinstance(value, ast.IfExp) else [value]):
+                        cn = _ctor_class(v)
+                        if cn is None and isinstance(v, ast.Name):
+                            cn = params.get(v.id)  # annotated ctor param
+                        if cn:
+                            ci.bindings.setdefault(attr, set()).add(cn)
+            classes[ci.name] = ci
+    return classes
+
+
+class _Resolver:
+    """Expression -> canonical locks / callees, inside one method."""
+
+    def __init__(self, classes: Dict[str, _ClassInfo], ci: _ClassInfo,
+                 fn: ast.AST):
+        self.classes = classes
+        self.ci = ci
+        # local aliases: name -> class names (x = self.attr / x = Cls())
+        self.local: Dict[str, Set[str]] = {}
+        for _ in range(2):  # two passes cover x = y chains
+            for n in _iter_own(fn):
+                if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                    continue
+                t = n.targets[0]
+                if not isinstance(t, ast.Name):
+                    continue
+                got: Set[str] = set()
+                vals = ([n.value.body, n.value.orelse]
+                        if isinstance(n.value, ast.IfExp) else [n.value])
+                for v in vals:
+                    got |= self._classes_of(v)
+                if got:
+                    self.local[t.id] = got
+
+    def _classes_of(self, expr: ast.AST) -> Set[str]:
+        """Class names an expression may evaluate to an instance of."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return {self.ci.name}
+            return set(self.local.get(expr.id, ()))
+        if isinstance(expr, ast.Attribute):
+            out: Set[str] = set()
+            for base in self._classes_of(expr.value):
+                bci = self.classes.get(base)
+                if bci:
+                    out |= bci.bindings.get(expr.attr, set())
+            return out
+        cn = _ctor_class(expr)
+        if cn and cn in self.classes:
+            return {cn}
+        return set()
+
+    def locks_of(self, expr: ast.AST) -> Set[Tuple[str, bool]]:
+        """(canonical, reentrant) for a `with` context expression."""
+        if not isinstance(expr, ast.Attribute):
+            return set()
+        out: Set[Tuple[str, bool]] = set()
+        for base in self._classes_of(expr.value):
+            bci = self.classes.get(base)
+            if bci and expr.attr in bci.locks:
+                out.add((canonical_name(base, expr.attr),
+                         bci.locks[expr.attr]))
+        return out
+
+    def callees(self, call: ast.Call) -> List[Tuple[_ClassInfo, str]]:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            cn = _ctor_class(call)
+            if cn and cn in self.classes \
+                    and "__init__" in self.classes[cn].methods:
+                return [(self.classes[cn], "__init__")]
+            return []
+        out = []
+        for base in self._classes_of(f.value):
+            bci = self.classes.get(base)
+            if bci and f.attr in bci.methods:
+                out.append((bci, f.attr))
+        return out
+
+    def blocking_desc(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "sleep" and isinstance(f.value, ast.Name) \
+                and f.value.id == "time":
+            return "time.sleep"
+        if f.attr == "device_get" and isinstance(f.value, ast.Name) \
+                and f.value.id == "jax":
+            return "jax.device_get"
+        if f.attr == "block_until_ready":
+            return "block_until_ready"
+        if f.attr == "join" and isinstance(f.value, ast.Attribute) \
+                and isinstance(f.value.value, ast.Name) \
+                and f.value.value.id == "self":
+            return f"self.{f.value.attr}.join"
+        if f.attr in ("get", "put"):
+            recv = f.value
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" \
+                    and recv.attr in self.ci.queues:
+                if any(k.arg == "block"
+                       and isinstance(k.value, ast.Constant)
+                       and k.value.value is False for k in call.keywords):
+                    return None
+                if f.attr == "get":
+                    return f"blocking self.{recv.attr}.get"
+                if self.ci.queues[recv.attr]:  # put blocks only when bounded
+                    return f"self.{recv.attr}.put on a bounded queue"
+        return None
+
+
+def _seed_holds(ci: _ClassInfo, mname: str) -> Tuple[str, ...]:
+    attr = ci.holds.get(mname)
+    if attr:
+        return (canonical_name(ci.name, attr),)
+    return ()
+
+
+def _summaries(classes: Dict[str, _ClassInfo]):
+    """Fixpoint may-acquire / may-block summaries per (class, method).
+    Lines waived with allow(lock-order)/allow(lock-block) are excluded,
+    so an explicitly sanctioned site does not re-flag every caller."""
+    acquires: Dict[Tuple[str, str], Set[str]] = {}
+    blocks: Dict[Tuple[str, str], Set[str]] = {}
+    resolvers: Dict[Tuple[str, str], _Resolver] = {}
+    for ci in classes.values():
+        for mname, fn in ci.methods.items():
+            resolvers[(ci.name, mname)] = _Resolver(classes, ci, fn)
+            acquires[(ci.name, mname)] = set()
+            blocks[(ci.name, mname)] = set()
+
+    changed = True
+    while changed:
+        changed = False
+        for ci in classes.values():
+            for mname, fn in ci.methods.items():
+                key = (ci.name, mname)
+                res = resolvers[key]
+                acq = set(acquires[key])
+                blk = set(blocks[key])
+                for n in _iter_own(fn):
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        if allowed(ci.sf, RULE_ORDER, n.lineno):
+                            continue
+                        for item in n.items:
+                            for canon, _ in res.locks_of(item.context_expr):
+                                acq.add(canon)
+                    elif isinstance(n, ast.Call):
+                        desc = res.blocking_desc(n)
+                        if desc and not allowed(ci.sf, RULE_BLOCK,
+                                                n.lineno, fn.lineno):
+                            blk.add(desc)
+                        for dci, dm in res.callees(n):
+                            if allowed(ci.sf, RULE_ORDER, n.lineno):
+                                pass
+                            else:
+                                acq |= acquires[(dci.name, dm)]
+                            if not allowed(ci.sf, RULE_BLOCK,
+                                           n.lineno, fn.lineno):
+                                blk |= blocks[(dci.name, dm)]
+                if acq != acquires[key] or blk != blocks[key]:
+                    acquires[key], blocks[key] = acq, blk
+                    changed = True
+    return acquires, blocks, resolvers
+
+
+def _is_sched_lock(canon: str) -> bool:
+    return canon == "_book" or canon.endswith("._book")
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components of size >= 2 (Tarjan)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def visit(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                visit(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) >= 2:
+                out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            visit(v)
+    return out
+
+
+def run(files: List[SourceFile], ctx: Context) -> List[Finding]:
+    classes = _collect_classes(files)
+    if not classes:
+        return []
+    for sf in files:
+        attach_parents(sf.tree)
+    acquires, blocks, resolvers = _summaries(classes)
+
+    findings: List[Finding] = []
+    edges: Dict[Tuple[str, str], List[_Site]] = {}
+    reentrant: Set[str] = set()
+    for ci in classes.values():
+        for attr, reent in ci.locks.items():
+            if reent:
+                reentrant.add(canonical_name(ci.name, attr))
+
+    def check_edge(sf: SourceFile, line: int, qn: str, held: str,
+                   acq: str, how: str) -> None:
+        if held != acq:
+            edges.setdefault((held, acq), []).append((sf, line, qn))
+        reason = edge_violation(held, acq)
+        if reason is None:
+            return
+        if held == acq and acq in reentrant:
+            return
+        if allowed(sf, RULE_ORDER, line):
+            return
+        findings.append(make_finding(
+            sf, RULE_ORDER, line, f"{how}: {reason}",
+            "follow the documented order in seldon_tpu/servers/"
+            "lock_order.py (outermost first): restructure so the inner "
+            "lock is taken before the outer one is held, or not at all",
+            qn))
+
+    for ci in classes.values():
+        sf = ci.sf
+        for mname, fn in ci.methods.items():
+            res = resolvers[(ci.name, mname)]
+            qn = f"{ci.name}.{mname}"
+            in_init = mname == "__init__"
+
+            def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda, ast.ClassDef)):
+                        continue
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        inner = held
+                        for item in child.items:
+                            for canon, _ in res.locks_of(item.context_expr):
+                                for h in inner:
+                                    check_edge(
+                                        sf, child.lineno, qn, h, canon,
+                                        f"`with` acquires '{canon}' while "
+                                        f"'{h}' is held")
+                                inner = inner + (canon,)
+                        # recurse through the With node itself so a body
+                        # statement that is ITSELF a With gets dispatched
+                        # (walking the body statements directly would
+                        # skip the isinstance check above for them)
+                        walk(child, inner)
+                        continue
+                    if isinstance(child, ast.Call):
+                        _check_call(child, held)
+                    walk(child, held)
+
+            def _check_call(call: ast.Call, held: Tuple[str, ...]) -> None:
+                line = call.lineno
+                callees = res.callees(call)
+                # holds-site: callee documents a lock the caller must own
+                for dci, dm in callees:
+                    attr = dci.holds.get(dm)
+                    if not attr:
+                        continue
+                    need = canonical_name(dci.name, attr)
+                    if need in held or in_init:
+                        continue
+                    if allowed(sf, RULE_HOLDS, line, fn.lineno):
+                        continue
+                    dline = dci.methods[dm].lineno
+                    findings.append(make_finding(
+                        sf, RULE_HOLDS, line,
+                        f"call to {dci.name}.{dm} requires '{need}' held "
+                        f"(holds({attr}) at {dci.sf.rel}:{dline}) but no "
+                        "path here acquires it",
+                        f"wrap the call in `with self.{attr}:` (or the "
+                        f"owning object's lock), or annotate the caller "
+                        f"`# graftlint: holds({attr})` if every entry "
+                        "point owns it",
+                        qn))
+                if held:
+                    # lock-order: callee may acquire under what we hold
+                    for dci, dm in callees:
+                        for acq in acquires[(dci.name, dm)]:
+                            for h in held:
+                                check_edge(
+                                    sf, line, qn, h, acq,
+                                    f"call to {dci.name}.{dm} acquires "
+                                    f"'{acq}' while '{h}' is held")
+                    # lock-block: stalls with the scheduler lock held
+                    if any(_is_sched_lock(h) for h in held):
+                        descs = []
+                        d = res.blocking_desc(call)
+                        if d:
+                            descs.append(d)
+                        for dci, dm in callees:
+                            for d in sorted(blocks[(dci.name, dm)]):
+                                descs.append(f"{dci.name}.{dm} -> {d}")
+                        for d in descs:
+                            if allowed(sf, RULE_BLOCK, line, fn.lineno):
+                                continue
+                            findings.append(make_finding(
+                                sf, RULE_BLOCK, line,
+                                f"{d} while '_book' is held stalls every "
+                                "scheduler client (admission, cancel, "
+                                "metrics, drain)",
+                                "move the blocking operation outside "
+                                "`with self._book:` (fetch at the "
+                                "boundary, use *_nowait, sleep outside "
+                                "the lock), or waive a deliberate stall "
+                                "with `# graftlint: allow(lock-block) "
+                                "<why>`",
+                                qn))
+
+            walk(fn, _seed_holds(ci, mname))
+
+    # Cycle detection over the full derived graph (ranked or not).
+    graph: Dict[str, Set[str]] = {}
+    for (h, a) in edges:
+        graph.setdefault(h, set()).add(a)
+        graph.setdefault(a, set())
+    for comp in _cycles(graph):
+        cyc = " -> ".join(comp + [comp[0]])
+        for (h, a), sites in sorted(edges.items()):
+            if h in comp and a in comp:
+                sf, line, qn = sites[0]
+                if allowed(sf, RULE_ORDER, line):
+                    continue
+                findings.append(make_finding(
+                    sf, RULE_ORDER, line,
+                    f"lock-order cycle: {cyc} (this edge acquires "
+                    f"'{a}' while '{h}' is held)",
+                    "impose a single acquisition order for these locks "
+                    "(see seldon_tpu/servers/lock_order.py) — a cycle "
+                    "means two threads can deadlock against each other",
+                    qn))
+    return findings
