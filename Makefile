@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -69,13 +69,21 @@ fuzz-graftsan:
 	  FUZZ_EXAMPLES=$${FUZZ_EXAMPLES:-600} \
 	  python -m pytest tests/test_graftsan.py -q -m fuzz
 
+# Observability smoke (docs/operations.md "Reading a flight recording"):
+# short loadtester run against the tiny server with TRACING=1 +
+# FLIGHT_RECORDER=1 + GRAFTSAN=1 — asserts a non-empty span sink,
+# end-to-end trace-id adoption, a valid Perfetto conversion of
+# /debug/timeline, and zero graftsan violations.
+trace-smoke:
+	env JAX_PLATFORMS=cpu python -m tools.trace_smoke
+
 bench:
 	python bench.py
 
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize
+ci: lint test test-e2e sanitize trace-smoke
 
 native-tsan:
 	$(MAKE) -C native tsan

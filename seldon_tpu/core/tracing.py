@@ -179,6 +179,46 @@ class Tracer:
             except Exception:  # never let the sink break the request path
                 pass
 
+    # -- retro-emission ------------------------------------------------------
+
+    def emit_span(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        parent: Optional[SpanContext] = None,
+        context: Optional[SpanContext] = None,
+        attributes: Optional[Dict[str, Any]] = None,
+        status: str = "OK",
+    ) -> Optional[SpanContext]:
+        """Export a span after the fact, from recorded timestamps — no
+        contextvars, no `with` scope. The engine scheduler uses this to
+        reconstruct a request's lifecycle (queued/prefill/decode) at
+        terminal time instead of holding open span objects on the hot
+        path. Returns the span's context (for parenting children), or
+        None when the tracer is disabled."""
+        if not self.enabled:
+            return None
+        ctx = context or SpanContext(
+            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+        )
+        span = Span(
+            name=name,
+            context=ctx,
+            parent_id=parent.span_id if parent else None,
+            service=self.service,
+            start_ns=start_ns,
+            end_ns=end_ns,
+            attributes=dict(attributes or {}),
+            status=status,
+        )
+        try:
+            self.exporter.export(span)
+        except Exception:  # never let the sink break the request path
+            pass
+        return ctx
+
     # -- propagation ---------------------------------------------------------
 
     def inject(self, carrier: Dict[str, str]) -> Dict[str, str]:
@@ -248,3 +288,12 @@ def get_tracer(service: str, exporter=None) -> Tracer:
 
 def current_span() -> Optional[Span]:
     return _current_span.get()
+
+
+def new_traceparent() -> str:
+    """A fresh W3C traceparent with random trace/span ids — for clients
+    (loadtester) stamping requests so server-side spans can be pulled
+    from the sink by trace id."""
+    return SpanContext(
+        trace_id=secrets.token_hex(16), span_id=secrets.token_hex(8)
+    ).to_traceparent()

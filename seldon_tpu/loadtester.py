@@ -24,6 +24,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from seldon_tpu.core import tracing
+
 
 async def _closed_loop(url_path: str, body: bytes, clients: int,
                        seconds: float, on_response=None, on_reject=None):
@@ -156,7 +158,8 @@ async def run_generate(url: str, clients: int, seconds: float,
                        stream: bool = True,
                        decode_len_dist: str = "",
                        cancel_frac: float = 0.0,
-                       deadline_ms: int = 0):
+                       deadline_ms: int = 0,
+                       trace_sample: float = 0.0):
     """LLM serving load: closed-loop generation clients. Latency is full
     completion time; tokens/s is the serving-throughput number. Greedy
     by default so completion lengths — and therefore tokens/s — are
@@ -186,10 +189,18 @@ async def run_generate(url: str, clients: int, seconds: float,
     max_tokens); deadline_ms > 0 stamps a per-request TTL on every
     request. Every request lands in exactly one `outcomes` bucket
     ({completed, shed, draining, deadline, cancelled, error}); `errors`
-    stays the legacy everything-not-completed total."""
+    stays the legacy everything-not-completed total.
+
+    trace_sample > 0 stamps that fraction of requests with a freshly
+    generated W3C traceparent (riding meta.tags like deadline_ms — the
+    server-side engine adopts it when TRACING=1), and the sampled trace
+    ids come back in the outcome ledger so a run's server-side spans
+    can be pulled from the TRACING_FILE JSONL sink by trace id."""
     dist = parse_decode_len_dist(decode_len_dist)
     len_rng = np.random.default_rng(1)
     cancel_rng = np.random.default_rng(2)
+    trace_rng = np.random.default_rng(3)
+    sampled_traces: List[str] = []
     tokens = [0]
     ttfts: List[float] = []
     itls: List[float] = []
@@ -260,11 +271,18 @@ async def run_generate(url: str, clients: int, seconds: float,
             "prompt": p, "max_new_tokens": mnt,
             "temperature": temperature,
         }
+        tags = {}
         if deadline_ms > 0:
             # The REST edge parses this into a proto GenerateRequest,
             # which has no deadline field — the TTL rides meta.tags
             # (see seldon_methods._generate_request_dict).
-            d["meta"] = {"tags": {"deadline_ms": deadline_ms}}
+            tags["deadline_ms"] = deadline_ms
+        if trace_sample > 0.0 and trace_rng.random() < trace_sample:
+            tp = tracing.new_traceparent()
+            sampled_traces.append(tp.split("-")[1])  # bare trace id
+            tags["traceparent"] = tp
+        if tags:
+            d["meta"] = {"tags": tags}
         return json.dumps(d).encode()
 
     if shared_prefix_frac > 0.0:
@@ -300,6 +318,11 @@ async def run_generate(url: str, clients: int, seconds: float,
                 stream_stats[f"{name}_p{q}_ms"] = round(
                     float(np.percentile(arr, q)), 2
                 )
+    if trace_sample > 0.0:
+        # First few sampled ids in the ledger (the full run may sample
+        # thousands): each one keys the server's TRACING_FILE JSONL sink.
+        stream_stats["trace_sampled"] = len(sampled_traces)
+        stream_stats["trace_ids"] = sampled_traces[:16]
     return total, dt, lats, errors, tokens[0], stream_stats, outcomes
 
 
@@ -365,6 +388,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="--transport generate: per-request TTL in "
                              "ms stamped on every request (deadline "
                              "injection); 0 disables")
+    parser.add_argument("--trace-sample", type=float, default=0.0,
+                        help="--transport generate: fraction of requests "
+                             "stamped with a generated W3C traceparent "
+                             "(server adopts it when TRACING=1); sampled "
+                             "trace ids print in the outcome ledger for "
+                             "span-sink lookup. 0 disables")
     args = parser.parse_args(argv)
 
     if args.transport == "generate":
@@ -375,7 +404,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                          args.shared_prefix, stream=not args.no_stream,
                          decode_len_dist=args.decode_len_dist,
                          cancel_frac=args.cancel_frac,
-                         deadline_ms=args.deadline_ms)
+                         deadline_ms=args.deadline_ms,
+                         trace_sample=args.trace_sample)
         )
         extra = {"completion_tokens": toks,
                  "tokens_per_s": round(toks / dt, 1) if dt else 0.0,

@@ -28,6 +28,12 @@ class SamplingParams:
     # Expired requests are shed from the queue or finalized early at the
     # next scheduler boundary (servers/engine.py request lifecycle).
     deadline_ms: int = 0
+    # W3C traceparent adopting the caller's trace: engine lifecycle spans
+    # parent under it so one trace id covers orchestrator -> engine ->
+    # streamed tokens. "" = no incoming context (the engine roots its own
+    # trace when tracing is on). Rides meta.tags["traceparent"] over the
+    # proto transports, same route as deadline_ms.
+    traceparent: str = ""
 
 
 def _mask_top_k_top_p(

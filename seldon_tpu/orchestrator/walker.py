@@ -265,6 +265,11 @@ class PredictorEngine:
     ) -> pb.SeldonMessage:
         ctx.request_path[unit.name] = unit.image or unit.name
         hard = self._hardcoded.get(unit.name)
+        if not self.tracer.enabled:
+            # Zero-allocation disabled path: no span-info tuple unpack,
+            # no context-manager entry (even the shared noop CM costs a
+            # __enter__/__exit__ pair per unit per request).
+            return await self._walk_unit(msg, unit, hard, ctx)
         span_name, span_attrs = self._span_info[unit.name]
         with self.tracer.span(span_name, attributes=span_attrs):
             return await self._walk_unit(msg, unit, hard, ctx)

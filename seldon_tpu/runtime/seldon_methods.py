@@ -235,6 +235,13 @@ def _generate_request_dict(request: pb.GenerateRequest) -> dict:
             )
         except ValueError:
             pass
+    # Trace context rides the same tag map (stamped by the transport edge
+    # from the HTTP header / gRPC metadata): the engine adopts it so its
+    # lifecycle spans share the caller's trace id.
+    if "traceparent" in request.meta.tags:
+        tp = request.meta.tags["traceparent"].string_value
+        if tp:
+            d["traceparent"] = tp
     return d
 
 

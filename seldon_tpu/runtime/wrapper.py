@@ -66,6 +66,23 @@ def _unit_name() -> str:
 
     return os.environ.get("PREDICTIVE_UNIT_ID", "model")
 
+
+def _stamp_traceparent(msg, carrier) -> None:
+    """Copy an incoming traceparent (HTTP headers / gRPC invocation
+    metadata) into the request's meta.tags so downstream consumers (the
+    engine via SamplingParams.traceparent) adopt the caller's trace.
+    Same adoption rule on both transports, and an explicit tag already
+    set by the client wins — mirroring how deadline_ms rides the tag
+    map."""
+    try:
+        if "traceparent" in msg.meta.tags:
+            return
+        ctx = tracing.Tracer.extract(carrier)
+        if ctx is not None:
+            msg.meta.tags["traceparent"].string_value = ctx.to_traceparent()
+    except Exception:  # propagation must never fail a served request
+        logger.exception("traceparent stamping failed")
+
 _METHOD_TABLE = {
     "predict": (seldon_methods.predict, pb.SeldonMessage),
     "transform-input": (seldon_methods.transform_input, pb.SeldonMessage),
@@ -176,6 +193,7 @@ def build_rest_app(
             msg, encoding = await _parse_request(request, pb.GenerateRequest)
         except Exception as e:
             return web.json_response(SeldonMicroserviceException(str(e)).to_dict(), status=400)
+        _stamp_traceparent(msg, request.headers)
         loop = asyncio.get_running_loop()
         t0 = time.perf_counter()
         try:
@@ -218,6 +236,7 @@ def build_rest_app(
             return web.json_response(
                 SeldonMicroserviceException(str(e)).to_dict(), status=400
             )
+        _stamp_traceparent(msg, request.headers)
         loop = asyncio.get_running_loop()
         t0 = time.perf_counter()
         q: asyncio.Queue = asyncio.Queue()
@@ -366,6 +385,26 @@ def build_rest_app(
         body, ctype = metrics.export()
         return web.Response(body=body, content_type=ctype.split(";")[0])
 
+    async def handle_timeline(request: web.Request) -> web.Response:
+        """Flight-recorder snapshot (docs/distributed-tracing.md). Duck-
+        typed on the user object so this module never imports the engine;
+        404 when the unit has no recorder or FLIGHT_RECORDER is off."""
+        fn = getattr(user_obj, "debug_timeline", None)
+        if not callable(fn):
+            return web.json_response(
+                {"error": "unit has no flight recorder"}, status=404
+            )
+        loop = asyncio.get_running_loop()
+        snap = await loop.run_in_executor(request.app["executor"], fn)
+        if snap is None:
+            return web.json_response(
+                {"error": "flight recorder disabled "
+                          "(set FLIGHT_RECORDER=1)"}, status=404
+            )
+        return web.json_response(snap)
+
+    app.router.add_get("/debug/timeline", handle_timeline)
+
     app.router.add_get("/live", handle_live)
     app.router.add_get("/health/live", handle_live)
     app.router.add_get("/ready", handle_ready)
@@ -449,6 +488,10 @@ class _UnitServicer:
         return resp
 
     def Generate(self, request, context):
+        _stamp_traceparent(
+            request,
+            context.invocation_metadata() if context is not None else None,
+        )
         return self._run("generate", seldon_methods.generate, request, context)
 
     def GenerateStream(self, request, context):
@@ -458,6 +501,10 @@ class _UnitServicer:
         here as client-liveness poll points (a cancelled RPC stops the
         stream and, via generator close, the engine request)."""
         t0 = time.perf_counter()
+        _stamp_traceparent(
+            request,
+            context.invocation_metadata() if context is not None else None,
+        )
         it = seldon_methods.generate_stream(self._user, request)
         try:
             try:

@@ -29,6 +29,7 @@ import collections
 import dataclasses
 import functools
 import logging
+import os
 import queue
 import threading
 import time
@@ -38,10 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from seldon_tpu.core import tracing
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
-from seldon_tpu.servers import graftsan
+from seldon_tpu.servers import flight_recorder, graftsan
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -279,6 +281,14 @@ class _Request:
     # store), acted on by the scheduler at the next boundary reap.
     deadline: Optional[float] = None
     cancelled: bool = False
+    # Tracing: the adopted caller SpanContext (parsed once at submit;
+    # None when tracing is off or no traceparent arrived) and the
+    # terminal outcome kind, stamped by _fail_req ("" at _complete =
+    # normal completion). Lifecycle spans are emitted retroactively at
+    # terminal time from the timestamps above, so the hot path never
+    # carries open span objects.
+    trace: Any = None
+    outcome: str = ""
 
 
 class EngineStats:
@@ -347,6 +357,45 @@ class EngineStats:
         self.cancelled_total = 0  # graftlint: guarded-by(lock) via(stats)
         self.deadline_expired_total = 0  # graftlint: guarded-by(lock) via(stats)
         self.queue_rejects = 0  # graftlint: guarded-by(lock) via(stats)
+        # SLO attainment: per-request deadline margin at terminal time
+        # (ms of deadline left; negative = finished/expired late) and
+        # goodput — completions that beat their deadline vs deadline-
+        # bearing requests that did not (expiries, cancels, late
+        # completions) vs requests that carried no deadline at all.
+        # Same fixed-edge idiom as the ITL histogram.
+        self.deadline_margin_edges_ms = (
+            -1000.0, -500.0, -200.0, -100.0, -50.0, -20.0, 0.0,
+            20.0, 50.0, 100.0, 200.0, 500.0, 1000.0,
+        )
+        self.deadline_margin_counts = [0] * (
+            len(self.deadline_margin_edges_ms) + 1
+        )  # graftlint: guarded-by(lock) via(stats)
+        self.deadline_margin_sum_ms = 0.0  # graftlint: guarded-by(lock) via(stats)
+        self.deadline_met_total = 0  # graftlint: guarded-by(lock) via(stats)
+        self.deadline_missed_total = 0  # graftlint: guarded-by(lock) via(stats)
+        self.completed_no_deadline_total = 0  # graftlint: guarded-by(lock) via(stats)
+
+    def record_slo_locked(self, margin_ms: Optional[float],  # graftlint: holds(lock)
+                          ok: bool) -> None:
+        """Caller holds self.lock. margin_ms None = the request carried
+        no deadline; ok = the terminal outcome was a normal completion.
+        Goodput counts a deadline-bearing request as met only when it
+        completed normally with margin to spare."""
+        if margin_ms is None:
+            if ok:
+                self.completed_no_deadline_total += 1
+            return
+        i = 0
+        for edge in self.deadline_margin_edges_ms:
+            if margin_ms <= edge:
+                break
+            i += 1
+        self.deadline_margin_counts[i] += 1
+        self.deadline_margin_sum_ms += margin_ms
+        if ok and margin_ms >= 0.0:
+            self.deadline_met_total += 1
+        else:
+            self.deadline_missed_total += 1
 
     def record_itl_locked(self, ms: float) -> None:  # graftlint: holds(lock)
         """Caller holds self.lock."""
@@ -431,6 +480,21 @@ class EngineStats:
                 "cancelled_total": self.cancelled_total,
                 "deadline_expired_total": self.deadline_expired_total,
                 "queue_rejects": self.queue_rejects,
+                "deadline_margin_edges_ms": list(
+                    self.deadline_margin_edges_ms
+                ),
+                "deadline_margin_counts": list(self.deadline_margin_counts),
+                "deadline_margin_sum_ms": self.deadline_margin_sum_ms,
+                "deadline_met_total": self.deadline_met_total,
+                "deadline_missed_total": self.deadline_missed_total,
+                "completed_no_deadline_total":
+                    self.completed_no_deadline_total,
+                "goodput": (
+                    self.deadline_met_total
+                    / (self.deadline_met_total + self.deadline_missed_total)
+                    if (self.deadline_met_total + self.deadline_missed_total)
+                    else 1.0
+                ),
             }
 
 
@@ -692,6 +756,25 @@ class InferenceEngine:
         self._jit_deactivate = jax.jit(
             self._deactivate_impl, donate_argnums=(0,)
         )
+        # Request-scoped tracing + flight recorder (both env-gated, both
+        # zero hot-path cost when off). Lifecycle spans are emitted
+        # retroactively at terminal time from _Request timestamps;
+        # perf_counter values convert to wall-clock ns through this
+        # init-time epoch pairing (Span timestamps are time_ns-domain).
+        self._tracer = tracing.get_tracer("engine")
+        self._recorder = flight_recorder.from_env()
+        self._epoch_perf = time.perf_counter()
+        self._epoch_ns = time.time_ns()
+        # Env-gated device-profile window: jax.profiler capture over the
+        # first TRACE_PROFILE_N dispatched boundaries (0 = off), so the
+        # device timeline can be lined up against the recorder's wall-
+        # clock boundary records (tools/profile_decode.py parse pattern).
+        self._profile_n = int(os.environ.get("TRACE_PROFILE_N", "0") or 0)
+        self._profile_dir = os.environ.get(
+            "TRACE_PROFILE_DIR", "/tmp/seldon-tpu-profile"
+        )
+        self._profile_count = 0
+        self._profile_active = False
         # Runtime concurrency sanitizer (GRAFTSAN=1; None — and zero
         # hot-path code — otherwise). Wraps every lock above in an
         # order-asserting proxy, so this must stay the LAST piece of
@@ -1292,6 +1375,15 @@ class InferenceEngine:
         # Transports read the rid off the returned queue to cancel() a
         # request whose client vanished mid-stream.
         req.out.rid = req.rid
+        if self._tracer.enabled and params.traceparent:
+            req.trace = tracing.SpanContext.from_traceparent(
+                params.traceparent
+            )
+        if self._recorder is not None:
+            self._recorder.record(
+                "submit", req.rid,
+                {"prompt_tokens": len(req.tokens), "deadline_ms": ttl_ms},
+            )
         with self.stats.lock:
             self.stats.requests += 1
         self._pending.put(req)
@@ -1343,6 +1435,14 @@ class InferenceEngine:
     def draining(self) -> bool:
         return self._draining.is_set()
 
+    def debug_timeline(self) -> Optional[Dict[str, Any]]:
+        """Flight-recorder snapshot (oldest-first records + epoch info),
+        or None when FLIGHT_RECORDER is off — the /debug/timeline
+        payload, and tools/trace_view.py's input."""
+        if self._recorder is None:
+            return None
+        return self._recorder.snapshot()
+
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful drain: stop admitting (submit raises EngineDraining),
         shed everything still queued with a retriable error, and wait up
@@ -1350,6 +1450,8 @@ class InferenceEngine:
         True once the engine is quiescent. The scheduler keeps running —
         call stop() afterwards to halt the threads (stop() drains any
         leftovers itself)."""
+        if self._recorder is not None and not self._draining.is_set():
+            self._recorder.record("drain", -1, {"timeout_s": timeout})
         self._draining.set()
         if self._thread is None or not self._thread.is_alive():
             # No scheduler to shed queued work on our behalf.
@@ -1744,6 +1846,13 @@ class InferenceEngine:
                 with self.stats.lock:
                     self.stats.prefix_hits += 1
                     self.stats.prefix_tokens_saved += handle.match_len
+            if self._recorder is not None:
+                self._recorder.record(
+                    "trie-hit" if handle.match_len else "trie-miss",
+                    req.rid,
+                    {"matched_tokens": handle.match_len,
+                     "prompt_tokens": len(req.tokens)},
+                )
         if req.prefix_len:
             return (
                 self._bucket(len(req.tokens) - req.prefix_len),
@@ -1771,6 +1880,14 @@ class InferenceEngine:
                 req.first_dispatch_at = now
                 wait += now - req.submitted_at
                 n += 1
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "admit", req.rid,
+                        {"queue_wait_ms":
+                            round(1000.0 * (now - req.submitted_at), 3),
+                         "prompt_tokens": len(req.tokens),
+                         "prefix_tokens": req.prefix_len or 0},
+                    )
         if n:
             with self.stats.lock:
                 self.stats.queue_wait_sum += wait
@@ -1805,6 +1922,11 @@ class InferenceEngine:
             if not group:
                 with self.stats.lock:
                     self.stats.pool_stalls += 1
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "pool-stall", self._waiting[0].rid,
+                        {"waiting": len(self._waiting)},
+                    )
                 break
             try:
                 admits.append(self._dispatch_admit_group(group, *key))
@@ -1986,6 +2108,10 @@ class InferenceEngine:
             if evicted:
                 with self.stats.lock:
                     self.stats.prefix_evictions += evicted
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "trie-evict", req.rid, {"evicted": evicted}
+                    )
 
     # --- paged-KV block bookkeeping ----------------------------------------
 
@@ -2006,6 +2132,10 @@ class InferenceEngine:
             if evicted:
                 with self.stats.lock:
                     self.stats.prefix_evictions += evicted
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "trie-evict", -1, {"evicted": evicted}
+                    )
         return self._allocator.free_count >= n
 
     def _secure_blocks(  # graftlint: holds(_book)
@@ -2038,6 +2168,12 @@ class InferenceEngine:
                 return None
             with self.stats.lock:
                 self.stats.preemptions += 1
+            if self._recorder is not None:
+                self._recorder.record(
+                    "preempt", victim.rid,
+                    {"requester": requester.rid if requester else -1,
+                     "need_blocks": n},
+                )
             logger.warning(
                 "preempting request %d: kv cache pool exhausted",
                 victim.rid,
@@ -2087,6 +2223,10 @@ class InferenceEngine:
                 bids.append(dst)
                 with self.stats.lock:
                     self.stats.cow_copies += 1
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "cow", req.rid, {"src": partial, "dst": dst}
+                    )
             with self.stats.lock:
                 self.stats.zero_copy_admissions += 1
         for i in range(len(bids), total):
@@ -2238,6 +2378,11 @@ class InferenceEngine:
                     # exhaustion rather than half-admit.
                     with self.stats.lock:
                         self.stats.pool_stalls += 1
+                    if self._recorder is not None:
+                        self._recorder.record(
+                            "pool-stall", req.rid,
+                            {"waiting": len(self._waiting)},
+                        )
                     break
                 self._waiting.popleft()
                 self._admit_chunk_slot(req)
@@ -2539,7 +2684,14 @@ class InferenceEngine:
         if self._chaos is not None and (
             threading.current_thread() is self._thread
         ):
-            self._chaos.on_dispatch(site)
+            try:
+                self._chaos.on_dispatch(site)
+            except Exception:
+                # An injected dispatch fault is about to unwind the
+                # scheduler iteration — pin it to the timeline first.
+                if self._recorder is not None:
+                    self._recorder.record("chaos", -1, {"site": site})
+                raise
 
     def _fail_req(self, req: _Request, msg: str,  # graftlint: holds(_book)
                   kind: str = "internal", retriable: bool = False) -> None:
@@ -2549,6 +2701,7 @@ class InferenceEngine:
         queued. Idempotent like _complete."""
         if req.finished:
             return
+        req.outcome = kind
         req.out.put({"error": msg, "kind": kind, "retriable": retriable})
         self._complete(req)
 
@@ -2560,6 +2713,19 @@ class InferenceEngine:
         if req.finished:
             return
         req.finished = True
+        now = time.perf_counter()
+        margin_ms = (
+            1000.0 * (req.deadline - now) if req.deadline is not None
+            else None
+        )
+        if self._tracer.enabled:
+            self._emit_request_spans(req, now, margin_ms)
+        if self._recorder is not None:
+            self._recorder.record(
+                "terminal", req.rid,
+                {"outcome": req.outcome or "ok",
+                 "n_generated": req.n_generated},
+            )
         with self._rid_lock:
             self._requests.pop(req.rid, None)
         if req.prefix_handle is not None:
@@ -2580,6 +2746,63 @@ class InferenceEngine:
             self._free.append(slot)
         with self.stats.lock:
             self.stats.completed += 1
+            self.stats.record_slo_locked(margin_ms, req.outcome == "")
+
+    def _perf_ns(self, t: float) -> int:
+        """perf_counter seconds -> wall-clock ns via the init-time epoch
+        pairing (Span start/end are time_ns-domain)."""
+        return self._epoch_ns + int((t - self._epoch_perf) * 1e9)
+
+    def _emit_request_spans(self, req: _Request, now: float,  # graftlint: holds(_book)
+                            margin_ms: Optional[float]) -> None:
+        """Retro-emit the request's lifecycle spans — one `engine.request`
+        root (adopting the caller's traceparent when one arrived) plus
+        queued/prefill/decode children — from the timestamps _Request
+        already carries. Runs exactly once per request, gated by the
+        `req.finished` flip in _complete, so terminal spans have the
+        same exactly-once guarantee as the out-queue sentinel."""
+        outcome = req.outcome or "ok"
+        attrs: Dict[str, Any] = {
+            "rid": req.rid,
+            "outcome": outcome,
+            "prompt_tokens": len(req.tokens),
+            "completion_tokens": req.n_generated,
+        }
+        if req.prefix_len:
+            attrs["prefix_tokens"] = req.prefix_len
+        if margin_ms is not None:
+            attrs["deadline_margin_ms"] = round(margin_ms, 3)
+        root = self._tracer.emit_span(
+            "engine.request",
+            self._perf_ns(req.submitted_at),
+            self._perf_ns(now),
+            parent=req.trace,
+            attributes=attrs,
+            status="OK" if outcome == "ok" else f"ERROR: {outcome}",
+        )
+        first = req.first_dispatch_at
+        self._tracer.emit_span(
+            "engine.queued",
+            self._perf_ns(req.submitted_at),
+            self._perf_ns(first if first is not None else now),
+            parent=root,
+        )
+        if first is not None:
+            tok = req.first_token_at
+            self._tracer.emit_span(
+                "engine.prefill",
+                self._perf_ns(first),
+                self._perf_ns(tok if tok is not None else now),
+                parent=root,
+            )
+            if tok is not None:
+                self._tracer.emit_span(
+                    "engine.decode",
+                    self._perf_ns(tok),
+                    self._perf_ns(now),
+                    parent=root,
+                    attributes={"tokens": req.n_generated},
+                )
 
     def _fail_all(self, err: str, pendings=()) -> None:  # graftlint: holds(_book)
         """Fail every live request and reset device + slot state — called
@@ -2588,6 +2811,8 @@ class InferenceEngine:
         optimistically recycled out of `_slots` live only there."""
         if self._san is not None:
             self._san.assert_holds("_book")
+        if self._recorder is not None:
+            self._recorder.record("fail-all", -1, {"error": err[:200]})
         live: Dict[int, _Request] = {}
         for req in self._slots:
             if req is not None:
@@ -2790,6 +3015,48 @@ class InferenceEngine:
             self._loop_async()
         else:
             self._loop_sync()
+        if self._profile_active:
+            # Window still open at shutdown: flush what was captured.
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # graftlint: allow(outcome) profiler flush is best-effort; no request state rides on it
+                logger.exception("TRACE_PROFILE_N flush failed")
+            self._profile_active = False
+
+    def _profile_tick(self) -> None:
+        """TRACE_PROFILE_N device-profile window: start a jax.profiler
+        capture at the first dispatched boundary, stop it after N — the
+        device timeline (tools/profile_decode.py parses the same
+        trace.json.gz) lines up against the recorder's wall-clock
+        "boundary" records via the profile-start/-stop markers. Called
+        from the scheduler loop OUTSIDE _book: profiler start/stop does
+        host I/O and must not block bookkeeping."""
+        if not self._profile_active:
+            try:
+                jax.profiler.start_trace(self._profile_dir)
+            except Exception:  # graftlint: allow(outcome) profiler start is best-effort; disables the window, never a request
+                logger.exception("TRACE_PROFILE_N start failed")
+                self._profile_n = 0
+                return
+            self._profile_active = True
+            if self._recorder is not None:
+                self._recorder.record(
+                    "profile-start", -1, {"dir": self._profile_dir}
+                )
+        self._profile_count += 1
+        if self._profile_count >= self._profile_n:
+            self._profile_n = 0  # window done; ticks stop
+            self._profile_active = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # graftlint: allow(outcome) profiler stop is best-effort; no request state rides on it
+                logger.exception("TRACE_PROFILE_N stop failed")
+            if self._recorder is not None:
+                self._recorder.record(
+                    "profile-stop", -1,
+                    {"dir": self._profile_dir,
+                     "boundaries": self._profile_count},
+                )
 
     def _dispatch_decode_chunk(self, n: int):  # graftlint: holds(_book)
         """Dispatch one n-step decode chunk. Dense engines call the slab
@@ -2916,6 +3183,13 @@ class InferenceEngine:
                 d.copy_to_host_async()
             for h in (toks, valid, active_after):
                 h.copy_to_host_async()
+            if self._recorder is not None:
+                self._recorder.record(
+                    "boundary", -1,
+                    {"admits": sum(len(g) for g, _, _, _ in admits),
+                     "chunk": n,
+                     "active": int(self._active_host.sum())},
+                )
             self._dispatch_wreck = None
             return (admits, (toks, valid, active_after), roster)
         self._dispatch_wreck = None
@@ -2936,6 +3210,8 @@ class InferenceEngine:
                 self._drain_and_fail(str(e), current=wreck)
                 continue
             if work is not None:
+                if self._profile_n:
+                    self._profile_tick()
                 # Bounded queue (maxsize=4): caps how far the host's
                 # slot-state view may lag behind retired boundaries.
                 # Blocks OUTSIDE the lock, so the fetcher keeps draining.
@@ -2971,6 +3247,15 @@ class InferenceEngine:
                             self.stats.decode_dispatches += 1
                             self.stats.decode_steps += n
                         self._recycle_budget_spent(roster, n)
+                        if self._recorder is not None:
+                            self._recorder.record(
+                                "boundary", -1,
+                                {"admits": sum(
+                                    len(g) for g, _, _, _ in admits
+                                 ),
+                                 "chunk": n,
+                                 "active": int(self._active_host.sum())},
+                            )
                     else:
                         chunk_handles = None
                     if pending is not None:
@@ -2983,6 +3268,8 @@ class InferenceEngine:
                     idle = (
                         pending is None and not self._active_host.any()
                     )
+                if self._profile_n and pending is not None:
+                    self._profile_tick()
                 # Sleep outside the lock so drain()/cancel() never wait
                 # on an idle tick.
                 if idle and self._pending.empty():

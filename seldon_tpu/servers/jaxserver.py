@@ -399,6 +399,17 @@ class JAXServer(SeldonComponent):
             v = request.get(key)
             return default if v is None else v
 
+        # Trace context: an explicit traceparent (stamped into the
+        # request dict by the transport edge from the HTTP header / gRPC
+        # metadata) wins; otherwise adopt whatever span is open on this
+        # thread of control (e.g. jaxserver.generate below, or the
+        # orchestrator's unit span for in-process graphs) so the
+        # engine's lifecycle spans join the same trace.
+        tp = str(get("traceparent", "") or "")
+        if not tp:
+            cur = tracing.current_span()
+            if cur is not None:
+                tp = cur.context.to_traceparent()
         return SamplingParams(
             temperature=float(get("temperature", 0.7)),
             top_k=int(get("top_k", 0)),
@@ -406,6 +417,7 @@ class JAXServer(SeldonComponent):
             max_new_tokens=int(get("max_new_tokens", 16) or 16),
             seed=int(get("seed", 0)),
             deadline_ms=int(get("deadline_ms", 0) or 0),
+            traceparent=tp,
         )
 
     def _prompt_ids(self, request: Dict) -> List[int]:
@@ -445,7 +457,15 @@ class JAXServer(SeldonComponent):
         self._ensure_loaded()
         t0 = time.perf_counter()
         ids = self._prompt_ids(request)
-        out_q = self.engine.submit(ids, self._to_sampling(request))
+        # Submission span: short-lived (covers the enqueue only — tokens
+        # stream for seconds after it closes), but it puts a jaxserver
+        # span in the trace and the engine's lifecycle spans parent
+        # under the same trace id via _to_sampling's adoption.
+        with self._tracer.span(
+            "jaxserver.generate_stream",
+            attributes={"prompt_tokens": len(ids)},
+        ):
+            out_q = self.engine.submit(ids, self._to_sampling(request))
         n = 0
         done = False
         try:
@@ -510,11 +530,50 @@ class JAXServer(SeldonComponent):
 
     # --- observability ------------------------------------------------------
 
+    def debug_timeline(self) -> Optional[Dict]:
+        """Engine flight-recorder snapshot for the /debug/timeline
+        endpoint (None when FLIGHT_RECORDER is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_timeline()
+
+    def _slo_metrics(self, s: Dict) -> List[Dict]:
+        """SLO attainment as a real Prometheus histogram: cumulative
+        `_bucket{le=...}` series (+Inf included) plus `_count`/`_sum`,
+        and the goodput counters, all from the stats snapshot."""
+        out: List[Dict] = []
+        cum = 0
+        edges = s["deadline_margin_edges_ms"]
+        counts = s["deadline_margin_counts"]
+        for edge, c in zip(list(edges) + ["+Inf"], counts):
+            cum += c
+            out.append({
+                "type": "GAUGE",
+                "key": "jaxserver_deadline_margin_ms_bucket",
+                "value": float(cum),
+                "tags": {"le": str(edge)},
+            })
+        out.extend([
+            {"type": "GAUGE", "key": "jaxserver_deadline_margin_ms_count",
+             "value": float(cum)},
+            {"type": "GAUGE", "key": "jaxserver_deadline_margin_ms_sum",
+             "value": float(s["deadline_margin_sum_ms"])},
+            {"type": "GAUGE", "key": "jaxserver_deadline_met_total",
+             "value": float(s["deadline_met_total"])},
+            {"type": "GAUGE", "key": "jaxserver_deadline_missed_total",
+             "value": float(s["deadline_missed_total"])},
+            {"type": "GAUGE", "key": "jaxserver_completed_no_deadline_total",
+             "value": float(s["completed_no_deadline_total"])},
+            {"type": "GAUGE", "key": "jaxserver_goodput",
+             "value": float(s["goodput"])},
+        ])
+        return out
+
     def metrics(self) -> List[Dict]:
         if not self._loaded:
             return []
         s = self.engine.stats.snapshot()
-        return [
+        return self._slo_metrics(s) + [
             {"type": "GAUGE", "key": "jaxserver_mean_ttft_ms",
              "value": s["mean_ttft_ms"]},
             {"type": "GAUGE", "key": "jaxserver_tokens_out",

@@ -163,3 +163,192 @@ def test_incoming_traceparent_becomes_root(tmp_path, monkeypatch):
     root = next(s for s in spans if s["name"] == "engine.predict")
     assert root["trace_id"] == "ee" * 16
     assert root["parent_id"] == "ff" * 8
+
+
+# ---------------------------------------------------------------------------
+# LLM engine lifecycle: traceparent over generate transports
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_traceparent_metadata_reaches_generate():
+    """Satellite contract: gRPC invocation metadata `traceparent` is
+    stamped into meta.tags with the same adoption rules as the HTTP
+    header — a body-supplied tag wins over transport metadata."""
+    from seldon_tpu.proto import prediction_grpc
+    from seldon_tpu.runtime.wrapper import build_grpc_server
+
+    seen = []
+
+    class Gen:
+        def generate(self, d):
+            seen.append(d.get("traceparent", ""))
+            return {"text": "ok", "token_ids": [1]}
+
+    server = build_grpc_server(Gen())
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    meta_tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    body_tp = "00-" + "12" * 16 + "-" + "34" * 8 + "-01"
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = prediction_grpc.TextGenStub(ch)
+        # Metadata-only: stamped into the request.
+        resp = stub.Generate(pb.GenerateRequest(prompt="x"),
+                             metadata=[("traceparent", meta_tp)])
+        assert resp.text == "ok"
+        # Body tag already present: metadata must NOT overwrite it.
+        req = pb.GenerateRequest(prompt="x")
+        req.meta.tags["traceparent"].string_value = body_tp
+        stub.Generate(req, metadata=[("traceparent", meta_tp)])
+        # Streaming entry point stamps identically.
+        list(stub.GenerateStream(pb.GenerateRequest(prompt="x"),
+                                 metadata=[("traceparent", meta_tp)]))
+    finally:
+        server.stop(0)
+    assert seen == [meta_tp, body_tp, meta_tp]
+
+
+def test_walker_disabled_tracer_takes_zero_alloc_path(monkeypatch):
+    """With tracing off, the per-unit walk must not touch any span
+    machinery: no span-info lookup, no context-manager entry."""
+    from seldon_tpu.orchestrator.spec import PredictiveUnit, PredictorSpec
+    from seldon_tpu.orchestrator.walker import PredictorEngine
+
+    spec = PredictorSpec(
+        name="p",
+        graph=PredictiveUnit(name="m", type="MODEL",
+                             implementation="SIMPLE_MODEL"),
+    )
+    engine = PredictorEngine(spec)
+    assert not engine.tracer.enabled  # TRACING unset in tests
+
+    root_spans = []
+    real_span = engine.tracer.span
+
+    def counting_span(name, **kw):
+        root_spans.append(name)
+        return real_span(name, **kw)
+
+    # The disabled tracer is a shared module singleton: patch through
+    # monkeypatch so the counting shim cannot leak into other tests.
+    monkeypatch.setattr(engine.tracer, "span", counting_span)
+
+    class _NoTouch(dict):
+        def __getitem__(self, key):
+            raise AssertionError(
+                "disabled tracer must not unpack span info")
+
+    engine._span_info = _NoTouch()
+    req = payloads.build_message(np.array([[1.0]], np.float32))
+    out = asyncio.run(engine.predict(req))
+    assert payloads.get_data_from_message(out).shape[0] == 1
+    # Only the root predict span wrapper runs (itself a shared noop CM);
+    # the per-unit hot path took the early return.
+    assert root_spans == ["engine.predict"]
+
+
+@pytest.mark.e2e
+def test_one_trace_spans_transports_and_engine_lifecycle(
+    tmp_path, monkeypatch
+):
+    """Acceptance: one client trace id spans the transport entry -> engine
+    lifecycle spans -> terminal outcome, over REST and gRPC, against a
+    real tiny JAXServer on real sockets. The flight recorder rides along
+    and /debug/timeline serves its window."""
+    import threading
+    import time as _time
+    import urllib.request
+
+    from aiohttp import web
+
+    from seldon_tpu.proto import prediction_grpc
+    from seldon_tpu.runtime.wrapper import build_grpc_server, build_rest_app
+    from seldon_tpu.servers.jaxserver import JAXServer
+
+    trace_file = tmp_path / "spans.jsonl"
+    monkeypatch.setenv("TRACING", "1")
+    monkeypatch.setenv("TRACING_FILE", str(trace_file))
+    monkeypatch.setenv("FLIGHT_RECORDER", "1")
+
+    srv = JAXServer(preset="tiny", max_slots=2, max_seq_len=32)
+    srv.load()
+
+    holder, started = {}, threading.Event()
+
+    async def amain():
+        runner = web.AppRunner(build_rest_app(srv))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    assert started.wait(30)
+    rest_tp = "00-" + "aa" * 16 + "-" + "bb" * 8 + "-01"
+    grpc_tp = "00-" + "cc" * 16 + "-" + "dd" * 8 + "-01"
+
+    gsrv = build_grpc_server(srv)
+    gport = gsrv.add_insecure_port("127.0.0.1:0")
+    gsrv.start()
+    try:
+        url = f"http://127.0.0.1:{holder['port']}"
+        body = json.dumps({"prompt": "hi", "max_new_tokens": 3,
+                           "temperature": 0.0}).encode()
+        req = urllib.request.Request(
+            f"{url}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     "traceparent": rest_tp})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert out["completion_tokens"] >= 1
+
+        ch = grpc.insecure_channel(f"127.0.0.1:{gport}")
+        stub = prediction_grpc.TextGenStub(ch)
+        gout = stub.Generate(
+            pb.GenerateRequest(prompt="hi", max_new_tokens=3,
+                               temperature=0.0),
+            metadata=[("traceparent", grpc_tp)], timeout=120)
+        assert len(gout.token_ids) >= 1
+
+        # Terminal spans are emitted by the scheduler thread; give the
+        # export a moment before asserting.
+        deadline = _time.monotonic() + 30
+        roots = []
+        while _time.monotonic() < deadline:
+            spans = [json.loads(l)
+                     for l in trace_file.read_text().splitlines()]
+            roots = [s for s in spans if s["name"] == "engine.request"]
+            if len(roots) >= 2:
+                break
+            _time.sleep(0.1)
+        by_trace = {s["trace_id"]: s for s in roots}
+        # Each transport's client trace id owns its engine lifecycle.
+        assert "aa" * 16 in by_trace and "cc" * 16 in by_trace, (
+            sorted(by_trace))
+        assert by_trace["aa" * 16]["parent_id"] == "bb" * 8
+        assert by_trace["cc" * 16]["parent_id"] == "dd" * 8
+        for root in by_trace.values():
+            assert root["attributes"]["outcome"] == "ok"
+            kids = [s for s in spans
+                    if s["parent_id"] == root["span_id"]]
+            names = {s["name"] for s in kids}
+            assert {"engine.queued", "engine.prefill",
+                    "engine.decode"} <= names, names
+            assert all(s["trace_id"] == root["trace_id"] for s in kids)
+
+        # Flight recorder rode along: the debug route serves the window.
+        with urllib.request.urlopen(f"{url}/debug/timeline",
+                                    timeout=30) as resp:
+            snap = json.loads(resp.read())
+        kinds = {r["kind"] for r in snap["records"]}
+        assert {"submit", "terminal"} <= kinds, kinds
+    finally:
+        gsrv.stop(0)
+        holder["stop"] = True
+        t.join(timeout=10)
+        srv.engine.stop()

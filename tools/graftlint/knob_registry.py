@@ -111,6 +111,22 @@ KNOBS = {
     "REDIS_SERVICE_PORT": _k("runtime", "6379", "Redis port."),
     "TRACING": _k("runtime", "0", "Enable request tracing."),
     "TRACING_FILE": _k("runtime", "(stdout)", "JSONL trace sink path."),
+    "FLIGHT_RECORDER": _k("runtime", "0",
+                          "Enable the engine flight recorder: a bounded "
+                          "ring of lifecycle/boundary records served at "
+                          "/debug/timeline (tools/trace_view.py renders "
+                          "Perfetto JSON from it)."),
+    "FLIGHT_RECORDER_SIZE": _k("runtime", "4096",
+                               "Flight-recorder ring capacity (records); "
+                               "older records are overwritten."),
+    "TRACE_PROFILE_N": _k("runtime", "0",
+                          "Capture a jax.profiler device trace over the "
+                          "first N dispatched scheduler boundaries "
+                          "(0 = off); profile-start/-stop markers land "
+                          "in the flight recording."),
+    "TRACE_PROFILE_DIR": _k("runtime", "/tmp/seldon-tpu-profile",
+                            "Output directory for the TRACE_PROFILE_N "
+                            "capture."),
     "PODINFO_ANNOTATIONS": _k("runtime", "/etc/podinfo/annotations",
                               "Downward-API annotations file."),
     "PREDICTOR_HOST": _k("runtime", "(unset)",

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""CI trace smoke: the flight-recorder/tracing stack end to end.
+
+Boots the tiny JAXServer behind the real REST app, drives it with a
+short closed-loop loadtester run at ``--trace-sample 1.0`` with
+``TRACING=1`` + ``FLIGHT_RECORDER=1`` (and ``GRAFTSAN=1`` unless the
+caller overrides), then asserts the whole observability contract in one
+pass:
+
+ * the loadtester ledger completes with zero transport errors and a
+   non-zero ``trace_sampled`` count;
+ * the span sink is non-empty, contains ``engine.request`` terminal
+   spans, and every loadtester-stamped trace id was adopted by the
+   engine (one trace id spans HTTP entry -> engine lifecycle);
+ * ``/debug/timeline`` returns a snapshot that ``tools/trace_view.py``
+   converts into valid Perfetto trace_event JSON (round-trips through
+   ``json``, non-empty ``traceEvents``, only legal ``ph`` values);
+ * the graftsan violation log is empty after the run.
+
+Run via ``make trace-smoke`` (wired into ``make ci``); exits non-zero
+with a one-line diagnosis on the first failed check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        print(f"trace-smoke FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sink = os.path.join(tempfile.mkdtemp(prefix="trace-smoke-"),
+                        "spans.jsonl")
+    os.environ["TRACING"] = "1"
+    os.environ["TRACING_FILE"] = sink
+    os.environ["FLIGHT_RECORDER"] = "1"
+    os.environ.setdefault("GRAFTSAN", "1")
+
+    import asyncio
+    import threading
+    import urllib.request
+
+    from aiohttp import web
+
+    from seldon_tpu.loadtester import main as lt_main
+    from seldon_tpu.runtime.wrapper import build_rest_app
+    from seldon_tpu.servers.jaxserver import JAXServer
+    from tools import trace_view
+
+    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=64)
+    srv.load()
+
+    holder, started = {}, threading.Event()
+
+    async def amain() -> None:
+        runner = web.AppRunner(build_rest_app(srv))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    _check(started.wait(60), "REST app failed to start within 60s")
+    url = f"http://127.0.0.1:{holder['port']}"
+
+    try:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            lt_main([
+                url, "--transport", "generate", "--clients", "2",
+                "--seconds", "2", "--prompt", "hi",
+                "--max-new-tokens", "4", "--trace-sample", "1.0",
+            ])
+        ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
+        detail = ledger["detail"]
+        _check(detail["errors"] == 0,
+               f"loadtester saw {detail['errors']} transport errors")
+        _check(detail["requests"] >= 1, "loadtester completed no requests")
+        _check(detail.get("trace_sampled", 0) >= 1,
+               "--trace-sample 1.0 stamped no trace ids")
+
+        # Snapshot the timeline while the engine is still up, through the
+        # real debug route (exercises the wrapper endpoint too).
+        with urllib.request.urlopen(f"{url}/debug/timeline",
+                                    timeout=10) as resp:
+            snap = json.loads(resp.read())
+    finally:
+        holder["stop"] = True
+        t.join(timeout=10)
+
+    # --- span sink: non-empty, terminal spans, trace-id adoption -------
+    with open(sink) as f:
+        spans = [json.loads(line) for line in f if line.strip()]
+    _check(len(spans) > 0, "span sink is empty")
+    roots = [s for s in spans if s["name"] == "engine.request"]
+    _check(len(roots) >= detail["requests"],
+           f"{len(roots)} engine.request spans < "
+           f"{detail['requests']} completed requests")
+    sink_traces = {s["trace_id"] for s in spans}
+    missing = [tid for tid in detail.get("trace_ids", [])
+               if tid not in sink_traces]
+    _check(not missing,
+           f"stamped trace ids never reached the span sink: {missing}")
+
+    # --- /debug/timeline -> Perfetto trace_event JSON ------------------
+    _check(snap.get("records"), "/debug/timeline returned no records")
+    kinds = {r["kind"] for r in snap["records"]}
+    _check("terminal" in kinds,
+           f"no terminal records in timeline (kinds: {sorted(kinds)})")
+    out = json.loads(json.dumps(trace_view.convert(snap)))
+    events = out["traceEvents"]
+    _check(len(events) > 0, "trace_view produced no traceEvents")
+    bad_ph = {e["ph"] for e in events} - {"X", "i", "C", "M"}
+    _check(not bad_ph, f"illegal trace_event phases: {sorted(bad_ph)}")
+
+    # --- graftsan: zero violations -------------------------------------
+    san = getattr(srv.engine, "_san", None)
+    if san is not None:
+        san.check()  # raises on the first recorded violation
+        _check(not san.violations, "graftsan recorded violations")
+    srv.engine.stop()
+
+    print(json.dumps({
+        "metric": "trace_smoke",
+        "value": 1,
+        "detail": {
+            "requests": detail["requests"],
+            "spans": len(spans),
+            "engine_request_spans": len(roots),
+            "timeline_records": len(snap["records"]),
+            "trace_events": len(events),
+            "graftsan": "on" if san is not None else "off",
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Flight-recording -> Chrome/Perfetto trace_event JSON.
+
+Input: a /debug/timeline snapshot (servers/flight_recorder.snapshot()
+shape) from a file or stdin; output: trace_event JSON that loads
+directly in Perfetto (ui.perfetto.dev) or chrome://tracing.
+
+    curl -s http://host:9000/debug/timeline | python tools/trace_view.py - \
+        > timeline.trace.json
+
+Rendering model:
+
+ * one "engine" process; request tracks keyed by rid, one scheduler
+   track (tid 0) for engine-wide events;
+ * per-request lifecycle becomes "X" duration slices — `queued`
+   (submit -> admit) and `running` (admit -> terminal), colored by the
+   terminal outcome via the slice name;
+ * point records (trie-hit/miss, cow, preempt, pool-stall, chaos,
+   drain, fail-all, profile markers) become "i" instants on the
+   owning request's track (engine-wide ones on the scheduler track);
+ * "boundary" records also emit a "C" counter series (`active_slots`)
+   so scheduler occupancy reads as a graph above the slices.
+
+Monotonic record timestamps convert to wall-clock microseconds via the
+snapshot's epoch pairing, so the device profile captured by
+TRACE_PROFILE_N (jax.profiler, see tools/profile_decode.py for the
+trace.json.gz parse) lines up on the same absolute axis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+# Records that close out a request's `running` slice.
+_TERMINAL = "terminal"
+# Point-event records rendered as instants (everything not lifecycle).
+_INSTANTS = (
+    "trie-hit", "trie-miss", "cow", "preempt", "pool-stall", "chaos",
+    "drain", "fail-all", "profile-start", "profile-stop", "shed",
+)
+
+
+def _wall_us(snapshot: Dict[str, Any], ts: float) -> float:
+    """Monotonic record ts -> absolute wall-clock microseconds."""
+    return (snapshot["epoch_wall"] + (ts - snapshot["epoch_mono"])) * 1e6
+
+
+def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """Flight-recorder snapshot -> trace_event JSON dict."""
+    events: List[Dict[str, Any]] = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "seldon-tpu engine"}},
+        {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+         "args": {"name": "scheduler"}},
+    ]
+    # rid -> (kind, ts, detail) markers collected for slice pairing.
+    submit: Dict[int, Any] = {}
+    admit: Dict[int, Any] = {}
+    named: set = set()
+
+    def track(rid: int) -> int:
+        if rid >= 0 and rid not in named:
+            named.add(rid)
+            events.append({
+                "ph": "M", "pid": 1, "tid": rid, "name": "thread_name",
+                "args": {"name": f"request {rid}"},
+            })
+        return max(rid, 0)
+
+    for rec in snapshot.get("records", []):
+        kind, rid = rec["kind"], int(rec.get("rid", -1))
+        ts = _wall_us(snapshot, rec["ts"])
+        detail = rec.get("detail") or {}
+        if kind == "submit":
+            submit[rid] = (ts, detail)
+        elif kind == "admit":
+            admit[rid] = (ts, detail)
+            if rid in submit:
+                t0, d0 = submit[rid]
+                events.append({
+                    "ph": "X", "pid": 1, "tid": track(rid),
+                    "name": "queued", "ts": t0, "dur": max(ts - t0, 0.1),
+                    "args": {**d0, **detail},
+                })
+        elif kind == _TERMINAL:
+            start = admit.get(rid) or submit.get(rid)
+            outcome = detail.get("outcome", "ok")
+            if start is not None:
+                t0, d0 = start
+                events.append({
+                    "ph": "X", "pid": 1, "tid": track(rid),
+                    "name": f"running [{outcome}]" if rid in admit
+                            else f"unadmitted [{outcome}]",
+                    "ts": t0, "dur": max(ts - t0, 0.1),
+                    "args": {**d0, **detail},
+                })
+            else:  # terminal with no earlier record in the window
+                events.append({
+                    "ph": "i", "pid": 1, "tid": track(rid),
+                    "name": f"terminal [{outcome}]", "ts": ts, "s": "t",
+                    "args": detail,
+                })
+            submit.pop(rid, None)
+            admit.pop(rid, None)
+        elif kind == "boundary":
+            events.append({
+                "ph": "i", "pid": 1, "tid": 0, "name": "boundary",
+                "ts": ts, "s": "t", "args": detail,
+            })
+            events.append({
+                "ph": "C", "pid": 1, "name": "active_slots", "ts": ts,
+                "args": {"active": detail.get("active", 0)},
+            })
+        else:
+            events.append({
+                "ph": "i", "pid": 1, "tid": track(rid), "name": kind,
+                "ts": ts, "s": "t" if rid >= 0 else "p", "args": detail,
+            })
+    # Requests still open at the end of the window: emit what is known
+    # so a truncated recording still renders (dur up to the last record).
+    if snapshot.get("records"):
+        end = _wall_us(snapshot, snapshot["records"][-1]["ts"])
+        for rid, (t0, d0) in list(admit.items()) + [
+            (r, v) for r, v in submit.items() if r not in admit
+        ]:
+            events.append({
+                "ph": "X", "pid": 1, "tid": track(rid),
+                "name": "in-flight (window end)",
+                "ts": t0, "dur": max(end - t0, 0.1), "args": d0,
+            })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "total_recorded": snapshot.get("total_recorded", 0),
+            "dropped": snapshot.get("dropped", 0),
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="flight-recorder timeline -> Perfetto trace_event JSON"
+    )
+    p.add_argument("input", help="/debug/timeline snapshot file, or - for "
+                                 "stdin")
+    p.add_argument("-o", "--output", default="",
+                   help="output path (default stdout)")
+    args = p.parse_args(argv)
+    raw = (sys.stdin.read() if args.input == "-"
+           else open(args.input).read())
+    snap = json.loads(raw)
+    if not isinstance(snap, dict) or "records" not in snap:
+        print("input is not a /debug/timeline snapshot "
+              "(missing 'records')", file=sys.stderr)
+        return 2
+    out = json.dumps(convert(snap))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())
