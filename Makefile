@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke bench bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -77,13 +77,27 @@ fuzz-graftsan:
 trace-smoke:
 	env JAX_PLATFORMS=cpu python -m tools.trace_smoke
 
+# Compile/device observatory gate (docs/operations.md "Diagnosing a
+# retrace storm"): warmed tiny server + loadtester with COMPILE_LEDGER +
+# HBM_LEDGER + DISPATCH_TIMING on — asserts ZERO live retraces after
+# warmup, a dispatched-variant count within the budget, per-variant
+# timing reaching stats/recorder/trace_view, and the /debug/compile +
+# /debug/hbm schemas.
+compile-audit:
+	env JAX_PLATFORMS=cpu python -m tools.compile_audit
+
 bench:
 	python bench.py
+
+# Perf-regression diff of two bench JSON files (docs/benchmarking.md
+# "Comparing runs"): make bench-compare BASE=BENCH_r05.json CAND=BENCH_r06.json
+bench-compare:
+	python -m tools.bench_compare $(BASE) $(CAND)
 
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke
+ci: lint test test-e2e sanitize trace-smoke compile-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

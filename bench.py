@@ -552,6 +552,22 @@ def _build(preset: str):
     return params, cfg
 
 
+def _compile_counts(engine) -> dict:
+    """Compile-ledger counters for a phase detail dict (COMPILE_LEDGER=1
+    is the bench default): variant count, live retraces, cumulative
+    compile seconds — so BENCH_*.json runs compare on compile behavior,
+    not just throughput, and tools/bench_compare.py can gate
+    live_retraces strictly. Empty when the ledger is off."""
+    snap = engine.debug_compile()
+    if snap is None:
+        return {}
+    return {
+        "compile_variants": snap["dispatched_variants"],
+        "live_retraces": snap["live_retrace_count"],
+        "compile_s_total": round(snap["compile_s_total"], 3),
+    }
+
+
 def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
                         admit: int = 8):
     """Saturated closed-loop wave -> (req_s, detail dict, sp factory)."""
@@ -608,6 +624,7 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
             if "ttft_ms" in item:
                 ttfts.append(item["ttft_ms"])
     dt = time.perf_counter() - t0
+    comp = _compile_counts(engine)
     engine.stop()
 
     detail = {
@@ -616,6 +633,7 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
         "p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 1),
         "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 1),
         "device": str(jax.devices()[0]),
+        **comp,
     }
     return n_req / dt, detail, sp
 
@@ -784,15 +802,18 @@ def _measure_chunked(params, cfg) -> dict:
         while lq.get(timeout=300) is not None:
             pass
         snap = engine.stats.snapshot()
+        comp = _compile_counts(engine)
         engine.stop()
         tail = [g for ts, g in gaps if ts >= t_long]
         run.last_snap = snap  # engine-side counters for the report
+        run.last_comp = comp
         return 1000.0 * float(np.percentile(tail or [0.0], 99))
 
     base_p99 = run(chunked=False)
     chunked_p99 = run(chunked=True)
     snap = run.last_snap
     return {
+        **run.last_comp,
         "streams": CHUNKED_STREAMS,
         "long_prompt_tokens": long_len,
         "prefill_chunk": PROMPT_LEN,
@@ -901,8 +922,10 @@ def _measure_paged(params, cfg) -> dict:
         drain(paged_eng.submit(shared, SamplingParams(
             temperature=0.0, max_new_tokens=new_toks, seed=100 + i)))
     s1 = paged_eng.stats.snapshot()
+    comp = _compile_counts(paged_eng)
     paged_eng.stop()
     return {
+        **comp,
         "kv_block": bs,
         "kv_pool_blocks": pool_blocks + 1,
         "dense_slots": PAGED_DENSE_SLOTS,
@@ -930,6 +953,12 @@ def main() -> None:
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:  # explicit pin beats the sitecustomize override (see probe)
         jax.config.update("jax_platforms", plat)
+
+    # Compile ledger on by default for bench runs: single-writer dict
+    # stores off the hot path, and the counters it yields
+    # (compile_variants / live_retraces) make BENCH_*.json runs
+    # auditable for retrace storms via tools/bench_compare.py.
+    os.environ.setdefault("COMPILE_LEDGER", "1")
 
     params, cfg = _build(PRESET)
     req_s, detail, sp = _measure_throughput(

@@ -326,6 +326,28 @@ async def run_generate(url: str, clients: int, seconds: float,
     return total, dt, lats, errors, tokens[0], stream_stats, outcomes
 
 
+def _compile_counts(url: str) -> dict:
+    """Best-effort /debug/compile poll after a run: folds the server's
+    compile-variant and live-retrace counts into the ledger so load
+    results carry their lattice cost. Empty when the server has no
+    compile ledger (COMPILE_LEDGER off -> the route 404s)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/compile", timeout=5
+        ) as resp:
+            comp = json.loads(resp.read())
+        return {
+            "compile_variants": int(comp["dispatched_variants"]),
+            "live_retraces": int(comp["live_retrace_count"]),
+            "compile_s_total": float(comp["compile_s_total"]),
+        }
+    except (OSError, ValueError, KeyError):
+        # 404 (ledger off), connection teardown, or a foreign schema —
+        # the ledger line simply goes without compile counters.
+        return {}
+
+
 def report(transport: str, total: int, dt: float, latencies, errors: int,
            clients: int, extra: Optional[dict] = None) -> dict:
     lats = np.asarray(latencies) * 1000.0 if latencies else np.zeros(1)
@@ -415,6 +437,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             extra["shared_prefix_frac"] = args.shared_prefix_frac
         if args.decode_len_dist:
             extra["decode_len_dist"] = args.decode_len_dist
+        extra.update(_compile_counts(args.url))
         report("generate", total, dt, lats, errors, args.clients,
                extra=extra)
         return

@@ -403,7 +403,30 @@ def build_rest_app(
             )
         return web.json_response(snap)
 
+    def _debug_route(attr: str, missing: str, disabled: str):
+        """Factory for duck-typed debug snapshot routes (compile/HBM
+        ledgers follow handle_timeline's shape: 404 with a hint when
+        the unit lacks the hook or the env knob is off)."""
+        async def handler(request: web.Request) -> web.Response:
+            fn = getattr(user_obj, attr, None)
+            if not callable(fn):
+                return web.json_response({"error": missing}, status=404)
+            loop = asyncio.get_running_loop()
+            snap = await loop.run_in_executor(request.app["executor"], fn)
+            if snap is None:
+                return web.json_response({"error": disabled}, status=404)
+            return web.json_response(snap)
+        return handler
+
     app.router.add_get("/debug/timeline", handle_timeline)
+    app.router.add_get("/debug/compile", _debug_route(
+        "debug_compile", "unit has no compile ledger",
+        "compile ledger disabled (set COMPILE_LEDGER=1)",
+    ))
+    app.router.add_get("/debug/hbm", _debug_route(
+        "debug_hbm", "unit has no hbm ledger",
+        "hbm ledger disabled (set HBM_LEDGER=1)",
+    ))
 
     app.router.add_get("/live", handle_live)
     app.router.add_get("/health/live", handle_live)

@@ -43,7 +43,8 @@ from seldon_tpu.core import tracing
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
-from seldon_tpu.servers import flight_recorder, graftsan
+from seldon_tpu.servers import compile_ledger, flight_recorder, graftsan
+from seldon_tpu.servers import hbm_ledger
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -374,6 +375,30 @@ class EngineStats:
         self.deadline_met_total = 0  # graftlint: guarded-by(lock) via(stats)
         self.deadline_missed_total = 0  # graftlint: guarded-by(lock) via(stats)
         self.completed_no_deadline_total = 0  # graftlint: guarded-by(lock) via(stats)
+        # Per-variant dispatch timing (DISPATCH_TIMING=1; empty dict —
+        # and no record_variant_locked calls — otherwise). Keyed by the
+        # compile-ledger variant string ("admit/64/4"); duration is the
+        # boundary-level host wall time measured at the deliberate
+        # device_get sync, bucketed on the same fixed-edge idiom as ITL.
+        self.dispatch_edges_ms = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+                                  100.0, 200.0, 500.0)
+        self.variant_ms = {}  # graftlint: guarded-by(lock) via(stats)
+
+    def record_variant_locked(self, key: str, ms: float) -> None:  # graftlint: holds(lock)
+        """Caller holds self.lock. One boundary duration for `key`."""
+        h = self.variant_ms.get(key)
+        if h is None:
+            h = {"count": 0, "sum_ms": 0.0,
+                 "counts": [0] * (len(self.dispatch_edges_ms) + 1)}
+            self.variant_ms[key] = h
+        i = 0
+        for edge in self.dispatch_edges_ms:
+            if ms <= edge:
+                break
+            i += 1
+        h["counts"][i] += 1
+        h["count"] += 1
+        h["sum_ms"] += ms
 
     def record_slo_locked(self, margin_ms: Optional[float],  # graftlint: holds(lock)
                           ok: bool) -> None:
@@ -495,6 +520,12 @@ class EngineStats:
                     if (self.deadline_met_total + self.deadline_missed_total)
                     else 1.0
                 ),
+                "dispatch_edges_ms": list(self.dispatch_edges_ms),
+                "variant_timing": {
+                    k: {"count": h["count"], "sum_ms": h["sum_ms"],
+                        "counts": list(h["counts"])}
+                    for k, h in self.variant_ms.items()
+                },
             }
 
 
@@ -775,6 +806,30 @@ class InferenceEngine:
         )
         self._profile_count = 0
         self._profile_active = False
+        # Compile & device observatory: variant ledger + live-retrace
+        # witness (COMPILE_LEDGER=1), per-variant boundary timing
+        # (DISPATCH_TIMING=1), HBM byte accounting (HBM_LEDGER=1). All
+        # None/False when off, and every dispatch site keeps its raw
+        # un-timed jit call on the off path — same zero-overhead-off
+        # contract as the recorder above.
+        self._cledger = compile_ledger.from_env()
+        self._timing_on = os.environ.get(
+            "DISPATCH_TIMING", "0"
+        ) in ("1", "true", "True")
+        self._observe = self._cledger is not None or self._timing_on
+        # Variant keys dispatched since the last boundary sync, paired
+        # with the boundary wall time in _process_boundary. Written only
+        # by the scheduler thread between dispatch and boundary.
+        self._wave_keys: List[Tuple[Any, ...]] = []
+        self._hbm = hbm_ledger.from_env()
+        if self._hbm is not None:
+            self._hbm.set_static("weights", sum(
+                int(x.nbytes)
+                for x in jax.tree_util.tree_leaves(params)
+            ))
+            self._hbm.gauge("kv_cache", self._hbm_kv_reserved_bytes)
+            self._hbm.gauge("kv_live", self._hbm_kv_live_bytes)
+            self._hbm.gauge("prefix_cache", self._hbm_prefix_bytes)
         # Runtime concurrency sanitizer (GRAFTSAN=1; None — and zero
         # hot-path code — otherwise). Wraps every lock above in an
         # order-asserting proxy, so this must stay the LAST piece of
@@ -1443,6 +1498,48 @@ class InferenceEngine:
             return None
         return self._recorder.snapshot()
 
+    def debug_compile(self) -> Optional[Dict[str, Any]]:
+        """Compile-ledger snapshot (variant lattice, warmup coverage,
+        live-retrace witnesses, cumulative compile seconds), or None
+        when COMPILE_LEDGER is off — the /debug/compile payload."""
+        if self._cledger is None:
+            return None
+        return self._cledger.snapshot()
+
+    def debug_hbm(self) -> Optional[Dict[str, Any]]:
+        """HBM-ledger snapshot (per-category bytes + high-watermarks),
+        or None when HBM_LEDGER is off — the /debug/hbm payload."""
+        if self._hbm is None:
+            return None
+        return self._hbm.snapshot()
+
+    def _hbm_kv_reserved_bytes(self) -> int:
+        """Static KV reservation: the full cache tree (dense slot slab
+        or paged block pool). nbytes is shape metadata — no sync."""
+        return sum(
+            int(x.nbytes)
+            for x in jax.tree_util.tree_leaves(self._state["cache"])
+        )
+
+    def _hbm_kv_live_bytes(self) -> int:
+        """Bytes of the reservation actually holding request state:
+        used blocks (paged) or occupied slots (dense), prorated over
+        the reservation. Snapshot-path only — allocator/_book locks are
+        taken cold here, never from the scheduler."""
+        total = self._hbm_kv_reserved_bytes()
+        if self._paged:
+            snap = self._allocator.snapshot()
+            return total * snap["used"] // max(1, snap["total"])
+        return total * self.slots_busy() // max(1, self.ecfg.max_slots)
+
+    def _hbm_prefix_bytes(self) -> int:
+        """Dense prefix-trie KV bytes (its KV copies live outside the
+        slot slab). Paged prefix shares pool blocks already counted in
+        kv_live, so it reports 0 rather than double-count."""
+        if self._prefix is None:
+            return 0
+        return int(self._prefix.snapshot().get("bytes", 0))
+
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful drain: stop admitting (submit raises EngineDraining),
         shed everything still queued with a retriable error, and wait up
@@ -1538,6 +1635,9 @@ class InferenceEngine:
         if self._thread is None:
             self._stop.clear()  # allow stop() -> start() restart
             self._draining.clear()
+            # Warmup dispatches never meet a boundary; drop their keys so
+            # the first live wave's timing isn't charged to them.
+            self._wave_keys = []
             if self._async_fetch:
                 self._fetcher = threading.Thread(
                     target=self._fetch_loop, daemon=True
@@ -1599,7 +1699,7 @@ class InferenceEngine:
                     break
                 if item is None:
                     continue
-                admits, _, roster = item
+                admits, _, roster, _ = item
                 for group, _, _, _ in admits:
                     for req in group:
                         live[req.rid] = req
@@ -1643,9 +1743,14 @@ class InferenceEngine:
         # All-True keep mask: a pure compile of the lifecycle-reap freeze
         # (identity on every row) so the first real cancel/deadline reap
         # never eats a compile mid-traffic.
+        if self._observe:
+            t0 = time.perf_counter()
         self._state = self._jit_deactivate(
             self._state, jnp.ones((self.ecfg.max_slots,), jnp.bool_)
         )
+        if self._observe:
+            self._note_dispatch(("deactivate",), -1,
+                                time.perf_counter() - t0)
         sizes = []
         g = 1
         while g <= self._max_admit:
@@ -1659,10 +1764,10 @@ class InferenceEngine:
             for n in self._chunk_sizes:
                 self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
             if self._paged:
-                self._state = self._jit_cow(
-                    self._state, jnp.int32(0), jnp.int32(0)
-                )
+                self._cow(0, 0)
             jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
+            if self._cledger is not None:
+                self._cledger.warmup_done()
             logger.info(
                 "engine warmed: %d prefill-chunk variants + %d decode "
                 "chunk sizes",
@@ -1683,6 +1788,8 @@ class InferenceEngine:
                 for G in sizes:
                     table = jnp.zeros((G, self._nbs), jnp.int32)
                     for W in widths:
+                        if self._observe:
+                            t0 = time.perf_counter()
                         self._state, _, _ = self._jit_admit_paged(
                             self.params,
                             self._state,
@@ -1698,13 +1805,18 @@ class InferenceEngine:
                             jnp.arange(G, dtype=jnp.int32),
                             prefix_width=W,
                         )
+                        if self._observe:
+                            self._note_dispatch(
+                                ("admit-paged", Sb, G, W), -1,
+                                time.perf_counter() - t0,
+                            )
                         n_warm += 1
-            self._state = self._jit_cow(
-                self._state, jnp.int32(0), jnp.int32(0)
-            )
+            self._cow(0, 0)
             for n in self._chunk_sizes:
                 self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
             jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
+            if self._cledger is not None:
+                self._cledger.warmup_done()
             logger.info(
                 "engine warmed (paged): %d admission variants + %d decode "
                 "chunk sizes",
@@ -1717,6 +1829,8 @@ class InferenceEngine:
         for Sb in self._buckets:
             for G in sizes:
                 # max_new=1 -> rows are first_done; no slot state leaks.
+                if self._observe:
+                    t0 = time.perf_counter()
                 out = admit(
                     self.params,
                     self._state,
@@ -1730,6 +1844,9 @@ class InferenceEngine:
                     jnp.arange(G, dtype=jnp.int32),
                 )
                 self._state = out[0]
+                if self._observe:
+                    self._note_dispatch(("admit", Sb, G), -1,
+                                        time.perf_counter() - t0)
                 if self._prefix is not None:
                     # Warm (prefix-hit) variants: one per
                     # (prefix bucket, suffix bucket, G). Zero prefix KV +
@@ -1738,6 +1855,8 @@ class InferenceEngine:
                         if Pb >= self.ecfg.max_seq_len:
                             continue
                         pkv = transformer.init_cache(self.cfg, G, Pb)
+                        if self._observe:
+                            t0 = time.perf_counter()
                         self._state, _, _, _ = self._jit_admit_prefix(
                             self.params,
                             self._state,
@@ -1752,12 +1871,19 @@ class InferenceEngine:
                             jnp.ones((G,), jnp.int32),
                             jnp.arange(G, dtype=jnp.int32),
                         )
+                        if self._observe:
+                            self._note_dispatch(
+                                ("admit-prefix", Pb, Sb, G), -1,
+                                time.perf_counter() - t0,
+                            )
                         n_warm += 1
         # All slots inactive: pure compile + masked no-op writes, one per
         # chunk-ladder rung.
         for n in self._chunk_sizes:
             self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
         jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
+        if self._cledger is not None:
+            self._cledger.warmup_done()
         logger.info(
             "engine warmed: %d admission variants (+%d prefix-warm) + %d "
             "decode chunk sizes",
@@ -1789,6 +1915,8 @@ class InferenceEngine:
                         jnp.arange(G, dtype=jnp.int32),
                         jnp.ones((G,), jnp.bool_),
                     )
+                    if self._observe:
+                        t0 = time.perf_counter()
                     if self._paged:
                         # All-trash tables keep the compile a no-op write.
                         out = self._jit_admit_chunk_paged(
@@ -1804,16 +1932,60 @@ class InferenceEngine:
                             prefix_width=W,
                         )
                     self._state = out[0]
+                    if self._observe:
+                        self._note_dispatch(("chunk", Sc, G, W), -1,
+                                            time.perf_counter() - t0)
                     n += 1
         if self._jit_seed_prefix is not None:
             for W in widths[1:]:
                 pkv_full = transformer.init_cache(self.cfg, 1, W)
                 pkv = {key: pkv_full[key][:, 0] for key in pkv_full}
+                if self._observe:
+                    t0 = time.perf_counter()
                 self._state = self._jit_seed_prefix(
                     self._state, pkv, jnp.int32(0)
                 )
+                if self._observe:
+                    self._note_dispatch(("seed-prefix", W), -1,
+                                        time.perf_counter() - t0)
                 n += 1
         return n
+
+    # --- compile/device observatory taps ------------------------------------
+
+    def _note_dispatch(self, key: Tuple[Any, ...], rid: int,
+                       seconds: float) -> None:
+        """Observatory tap behind every jit dispatch. Callers are the
+        warmup caller or the scheduler thread (same single-writer set as
+        the ledger requires); hot sites guard the surrounding
+        perf_counter pair on self._observe so the off path stays raw."""
+        if self._cledger is not None:
+            witness = self._cledger.dispatch(key, rid, seconds)
+            if witness is not None:
+                logger.warning(
+                    "live retrace: variant %s compiled in %.1f ms on the "
+                    "serving path (rid=%d)",
+                    witness["key"], witness["compile_ms"], rid,
+                )
+                if self._recorder is not None:
+                    self._recorder.record("retrace", rid, witness)
+        if self._timing_on:
+            self._wave_keys.append(key)
+
+    def _cow(self, src: int, dst: int, rid: int = -1) -> None:
+        """Copy-on-write block copy through the one shared jit variant
+        (src/dst are traced scalars). Every call site — warmup and
+        live — funnels through here so the ledger sees one "cow" key."""
+        if not self._observe:
+            self._state = self._jit_cow(
+                self._state, jnp.int32(src), jnp.int32(dst)
+            )
+            return
+        t0 = time.perf_counter()
+        self._state = self._jit_cow(
+            self._state, jnp.int32(src), jnp.int32(dst)
+        )
+        self._note_dispatch(("cow",), rid, time.perf_counter() - t0)
 
     # --- scheduler loop -----------------------------------------------------
 
@@ -1999,10 +2171,10 @@ class InferenceEngine:
             for req in group:
                 self._paged_admit_blocks(req, cows, cover=len(req.tokens))
             for src, dst in cows:
-                self._state = self._jit_cow(
-                    self._state, jnp.int32(src), jnp.int32(dst)
-                )
+                self._cow(src, dst, rid=group[0].rid)
             table = jnp.asarray(self._table_host[slots])
+            if self._observe:
+                t0 = time.perf_counter()
             self._state, first, first_done = self._jit_admit_paged(
                 self.params,
                 self._state,
@@ -2018,6 +2190,15 @@ class InferenceEngine:
                 jnp.asarray(slots),
                 prefix_width=Pb,
             )
+            if self._observe:
+                self._note_dispatch(
+                    ("admit-paged", Sb, Gp, Pb), group[0].rid,
+                    time.perf_counter() - t0,
+                )
+            if self._hbm is not None:
+                self._hbm.note_workspace(
+                    int(toks.nbytes) + Gp * self.cfg.vocab_size * 4
+                )
             for req in group:
                 self._slots[req.slot] = req
                 self._insert_paged_prompt(req, upto=len(req.tokens))
@@ -2039,6 +2220,8 @@ class InferenceEngine:
                 key: jnp.stack([r[key] for r in rows], axis=1)
                 for key in rows[0]
             }
+            if self._observe:
+                t0 = time.perf_counter()
             self._state, first, first_done, writes = self._jit_admit_prefix(
                 self.params,
                 self._state,
@@ -2053,9 +2236,16 @@ class InferenceEngine:
                 jnp.asarray(max_news),
                 jnp.asarray(slots),
             )
+            if self._observe:
+                self._note_dispatch(
+                    ("admit-prefix", Pb, Sb, Gp), group[0].rid,
+                    time.perf_counter() - t0,
+                )
         else:
             admit = self._jit_admit_sub if self._prefix is not None \
                 else self._jit_admit
+            if self._observe:
+                t0 = time.perf_counter()
             out = admit(
                 self.params,
                 self._state,
@@ -2068,11 +2258,20 @@ class InferenceEngine:
                 jnp.asarray(max_news),
                 jnp.asarray(slots),
             )
+            if self._observe:
+                self._note_dispatch(
+                    ("admit", Sb, Gp), group[0].rid,
+                    time.perf_counter() - t0,
+                )
             if self._prefix is not None:
                 self._state, first, first_done, writes = out
             else:
                 self._state, first, first_done = out
                 writes = None
+        if self._hbm is not None:
+            self._hbm.note_workspace(
+                int(toks.nbytes) + Gp * self.cfg.vocab_size * 4
+            )
         # Register rows now so an error path can fail them cleanly; the
         # active mirror is armed at boundary processing.
         for req in group:
@@ -2330,9 +2529,7 @@ class InferenceEngine:
                         req, cows, cover=req.prefix_len
                     )
                     for src, dst in cows:
-                        self._state = self._jit_cow(
-                            self._state, jnp.int32(src), jnp.int32(dst)
-                        )
+                        self._cow(src, dst, rid=req.rid)
                     req.prefill_done = req.prefix_len
             return
         if self._prefix is not None:
@@ -2340,9 +2537,16 @@ class InferenceEngine:
             if req.prefix_len:
                 W = self._bucket(req.prefix_len)
                 pkv = self._prefix.gather(req.prefix_handle, W)
+                if self._observe:
+                    t0 = time.perf_counter()
                 self._state = self._jit_seed_prefix(
                     self._state, pkv, jnp.int32(req.slot)
                 )
+                if self._observe:
+                    self._note_dispatch(
+                        ("seed-prefix", W), req.rid,
+                        time.perf_counter() - t0,
+                    )
                 req.prefill_done = req.prefix_len
                 with self.stats.lock:
                     self.stats.prefix_seed_copies += 1
@@ -2460,6 +2664,8 @@ class InferenceEngine:
                     for j, bid in enumerate(got):
                         self._table_host[req.slot, have + j] = bid
                     req.block_ids.extend(got)
+            if self._observe:
+                t0 = time.perf_counter()
             out = self._jit_admit_chunk_paged(
                 self.params,
                 self._state,
@@ -2479,6 +2685,8 @@ class InferenceEngine:
             self._state, first, first_done = out
             writes = None
         else:
+            if self._observe:
+                t0 = time.perf_counter()
             out = self._jit_admit_chunk(
                 self.params,
                 self._state,
@@ -2499,6 +2707,17 @@ class InferenceEngine:
             else:
                 self._state, first, first_done = out
                 writes = None
+        if self._observe:
+            # Dense and paged chunk kernels are twins — the mode is fixed
+            # per engine, so one "chunk" key family stays unambiguous.
+            self._note_dispatch(
+                ("chunk", Sc, Gp, W), group[0].rid,
+                time.perf_counter() - t0,
+            )
+        if self._hbm is not None:
+            self._hbm.note_workspace(
+                int(toks.nbytes) + Gp * self.cfg.vocab_size * 4
+            )
         finals_l = []
         for req, _, _, final, clen in rows:
             req.prefill_done += clen
@@ -2807,8 +3026,9 @@ class InferenceEngine:
     def _fail_all(self, err: str, pendings=()) -> None:  # graftlint: holds(_book)
         """Fail every live request and reset device + slot state — called
         when a dispatched computation errored (donated buffers are gone).
-        `pendings`: in-flight (admits, handles, roster) tuples — requests
-        optimistically recycled out of `_slots` live only there."""
+        `pendings`: in-flight (admits, handles, roster, timing) tuples —
+        requests optimistically recycled out of `_slots` live only
+        there."""
         if self._san is not None:
             self._san.assert_holds("_book")
         if self._recorder is not None:
@@ -2820,7 +3040,7 @@ class InferenceEngine:
         for pending in pendings:
             if pending is None:
                 continue
-            admits, _, roster = pending
+            admits, _, roster, _ = pending
             for group, _, _, _ in admits:
                 for req in group:
                     live[req.rid] = req
@@ -2866,9 +3086,11 @@ class InferenceEngine:
                 graftsan.rewrap_pool(self, self._san)
         self._state = self._fresh_state()
 
-    def _process_boundary(self, admits, chunk_handles, roster) -> None:  # graftlint: holds(_book)
+    def _process_boundary(self, admits, chunk_handles, roster,  # graftlint: holds(_book)
+                          timing=None) -> None:
         """Fetch one boundary's device results (one parallel transfer) and
-        run host bookkeeping."""
+        run host bookkeeping. `timing` is the wave's (dispatch t0,
+        variant keys) pair when DISPATCH_TIMING is on, None otherwise."""
         if self._chaos is not None:
             self._chaos.maybe_slow_boundary()  # graftlint: allow(lock-block) deliberate chaos fault: a slow boundary under _book is exactly the race window being tested
         admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync, lock-block) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
@@ -2880,8 +3102,34 @@ class InferenceEngine:
         self._process_admits(admits, admit_data)
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
+        self._record_wave_timing(timing)
         if self._san is not None:
             self._san.audit(self)
+
+    def _record_wave_timing(self, timing) -> None:  # graftlint: holds(_book)
+        """Per-variant boundary timing: the wave's dispatch keys against
+        the dispatch -> boundary-processed wall time, measured at the
+        deliberate device_get sync. Buckets into EngineStats and mirrors
+        one flight-recorder "dispatch" record per key (single-writer:
+        the scheduler thread or the fetcher under _book)."""
+        if timing is None:
+            return
+        t0, keys = timing
+        if not keys:
+            return
+        ms = 1000.0 * (time.perf_counter() - t0)
+        with self.stats.lock:
+            for key in keys:
+                self.stats.record_variant_locked(
+                    compile_ledger.key_str(key), ms
+                )
+        if self._recorder is not None:
+            for key in keys:
+                self._recorder.record(
+                    "dispatch", -1,
+                    {"variant": compile_ledger.key_str(key),
+                     "ms": round(ms, 3)},
+                )
 
     def _roster(self) -> List[Optional[_Request]]:  # graftlint: holds(_book)
         """Slot -> request snapshot for THIS wave's decode chunk. Mid-
@@ -2977,7 +3225,7 @@ class InferenceEngine:
             item = self._fetch_q.get()
             if item is None:
                 return
-            admits, chunk_handles, roster = item
+            admits, chunk_handles, roster, timing = item
             try:
                 if self._san is not None:
                     self._san.perturb("boundary")
@@ -2990,6 +3238,7 @@ class InferenceEngine:
                     self._process_admits(admits, admit_data)
                     if chunk_data is not None:
                         self._process_chunk(*chunk_data, roster)
+                    self._record_wave_timing(timing)
                     if self._san is not None:
                         self._san.audit(self)
             except Exception as e:
@@ -3019,7 +3268,8 @@ class InferenceEngine:
             # Window still open at shutdown: flush what was captured.
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # graftlint: allow(outcome) profiler flush is best-effort; no request state rides on it
+            except (RuntimeError, OSError, ValueError):
+                # Best-effort flush; no request state rides on it.
                 logger.exception("TRACE_PROFILE_N flush failed")
             self._profile_active = False
 
@@ -3034,7 +3284,8 @@ class InferenceEngine:
         if not self._profile_active:
             try:
                 jax.profiler.start_trace(self._profile_dir)
-            except Exception:  # graftlint: allow(outcome) profiler start is best-effort; disables the window, never a request
+            except (RuntimeError, OSError, ValueError):
+                # Best-effort start; disables the window, never a request.
                 logger.exception("TRACE_PROFILE_N start failed")
                 self._profile_n = 0
                 return
@@ -3049,7 +3300,8 @@ class InferenceEngine:
             self._profile_active = False
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # graftlint: allow(outcome) profiler stop is best-effort; no request state rides on it
+            except (RuntimeError, OSError, ValueError):
+                # Best-effort stop; no request state rides on it.
                 logger.exception("TRACE_PROFILE_N stop failed")
             if self._recorder is not None:
                 self._recorder.record(
@@ -3067,10 +3319,23 @@ class InferenceEngine:
         self._chaos_dispatch("decode")
         if self._paged:
             self._grow_decode_blocks(n)
-            return self._jit_chunks_paged[n](
+            if not self._observe:
+                return self._jit_chunks_paged[n](
+                    self.params, self._state, jnp.asarray(self._table_host)
+                )
+            t0 = time.perf_counter()
+            out = self._jit_chunks_paged[n](
                 self.params, self._state, jnp.asarray(self._table_host)
             )
-        return self._jit_chunks[n](self.params, self._state)
+            self._note_dispatch(("decode", n), -1,
+                                time.perf_counter() - t0)
+            return out
+        if not self._observe:
+            return self._jit_chunks[n](self.params, self._state)
+        t0 = time.perf_counter()
+        out = self._jit_chunks[n](self.params, self._state)
+        self._note_dispatch(("decode", n), -1, time.perf_counter() - t0)
+        return out
 
     def _reap_lifecycle(self) -> None:  # graftlint: holds(_book)
         """Boundary-time lifecycle pass (scheduler thread, under _book):
@@ -3146,13 +3411,19 @@ class InferenceEngine:
         if dead:
             keep = np.ones((self.ecfg.max_slots,), bool)
             keep[dead] = False
+            if self._observe:
+                t0 = time.perf_counter()
             self._state = self._jit_deactivate(
                 self._state, jnp.asarray(keep)
             )
+            if self._observe:
+                self._note_dispatch(("deactivate",), -1,
+                                    time.perf_counter() - t0)
 
     def _dispatch_once(self):  # graftlint: holds(_book)
         """One scheduling step under the bookkeeping lock. Returns the
-        (admits, chunk_handles, roster) boundary or None if idle. On an
+        (admits, chunk_handles, roster, timing) boundary or None if
+        idle. On an
         exception, self._dispatch_wreck holds the partial boundary so
         the error path can fail recycled-out-of-_slots requests."""
         self._dispatch_wreck = None
@@ -3161,10 +3432,10 @@ class InferenceEngine:
             self._dispatch_prefill_chunks() if self._chunked
             else self._dispatch_admits()
         )
-        self._dispatch_wreck = (admits, None, None)
+        self._dispatch_wreck = (admits, None, None, None)
         if admits or self._active_host.any():
             roster = self._roster()
-            self._dispatch_wreck = (admits, None, roster)
+            self._dispatch_wreck = (admits, None, roster, None)
             n = self._pick_chunk()
             self._state, toks, valid, active_after = (
                 self._dispatch_decode_chunk(n)
@@ -3190,8 +3461,13 @@ class InferenceEngine:
                      "chunk": n,
                      "active": int(self._active_host.sum())},
                 )
+            if self._timing_on:
+                timing = (time.perf_counter(), self._wave_keys)
+                self._wave_keys = []
+            else:
+                timing = None
             self._dispatch_wreck = None
-            return (admits, (toks, valid, active_after), roster)
+            return (admits, (toks, valid, active_after), roster, timing)
         self._dispatch_wreck = None
         return None
 
@@ -3223,7 +3499,7 @@ class InferenceEngine:
         # Slot/free-list/active bookkeeping runs under _book even in the
         # synchronous (no fetcher thread) mode: drain(), cancel paths and
         # debug_lifecycle_check() read the same state from other threads.
-        pending: Optional[Tuple[list, Any, list]] = None
+        pending: Optional[Tuple[list, Any, list, Any]] = None
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path
             try:
@@ -3258,10 +3534,17 @@ class InferenceEngine:
                             )
                     else:
                         chunk_handles = None
+                    if self._timing_on and (
+                        admits or chunk_handles is not None
+                    ):
+                        timing = (time.perf_counter(), self._wave_keys)
+                        self._wave_keys = []
+                    else:
+                        timing = None
                     if pending is not None:
                         self._process_boundary(*pending)
                     pending = (
-                        (admits, chunk_handles, roster)
+                        (admits, chunk_handles, roster, timing)
                         if (admits or chunk_handles is not None)
                         else None
                     )
@@ -3279,7 +3562,9 @@ class InferenceEngine:
                 # The CURRENT iteration's admits/roster may hold requests
                 # already recycled out of _slots — fail them too.
                 with self._book:
-                    self._fail_all(str(e), [pending, (admits, None, roster)])
+                    self._fail_all(
+                        str(e), [pending, (admits, None, roster, None)]
+                    )
                 pending = None
         # Drain the in-flight boundary so stop() doesn't strand requests.
         if pending is not None:

@@ -537,6 +537,56 @@ class JAXServer(SeldonComponent):
             return None
         return self.engine.debug_timeline()
 
+    def debug_compile(self) -> Optional[Dict]:
+        """Engine compile-ledger snapshot for the /debug/compile
+        endpoint (None when COMPILE_LEDGER is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_compile()
+
+    def debug_hbm(self) -> Optional[Dict]:
+        """Engine HBM-ledger snapshot for the /debug/hbm endpoint
+        (None when HBM_LEDGER is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_hbm()
+
+    def _observatory_metrics(self, s: Dict) -> List[Dict]:
+        """Compile-ledger and per-variant dispatch gauges. Empty when
+        the observatory is off — the Prometheus surface only grows for
+        operators who turned the knobs on."""
+        out: List[Dict] = []
+        comp = self.engine.debug_compile()
+        if comp is not None:
+            out.extend([
+                {"type": "GAUGE", "key": "jaxserver_compile_variants",
+                 "value": float(comp["dispatched_variants"])},
+                {"type": "GAUGE", "key": "jaxserver_live_retraces",
+                 "value": float(comp["live_retrace_count"])},
+                {"type": "GAUGE", "key": "jaxserver_compile_seconds_total",
+                 "value": float(comp["compile_s_total"])},
+            ])
+        for key, h in sorted(s.get("variant_timing", {}).items()):
+            out.extend([
+                {"type": "GAUGE",
+                 "key": "jaxserver_dispatch_ms_count",
+                 "value": float(h["count"]),
+                 "tags": {"variant": key}},
+                {"type": "GAUGE",
+                 "key": "jaxserver_dispatch_ms_sum",
+                 "value": float(h["sum_ms"]),
+                 "tags": {"variant": key}},
+            ])
+        hbm = self.engine.debug_hbm()
+        if hbm is not None:
+            for name, cat in sorted(hbm["categories"].items()):
+                out.append({
+                    "type": "GAUGE", "key": "jaxserver_hbm_bytes",
+                    "value": float(cat["bytes"]),
+                    "tags": {"category": name},
+                })
+        return out
+
     def _slo_metrics(self, s: Dict) -> List[Dict]:
         """SLO attainment as a real Prometheus histogram: cumulative
         `_bucket{le=...}` series (+Inf included) plus `_count`/`_sum`,
@@ -573,7 +623,7 @@ class JAXServer(SeldonComponent):
         if not self._loaded:
             return []
         s = self.engine.stats.snapshot()
-        return self._slo_metrics(s) + [
+        return self._slo_metrics(s) + self._observatory_metrics(s) + [
             {"type": "GAUGE", "key": "jaxserver_mean_ttft_ms",
              "value": s["mean_ttft_ms"]},
             {"type": "GAUGE", "key": "jaxserver_tokens_out",

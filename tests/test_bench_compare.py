@@ -1,0 +1,172 @@
+"""tools/bench_compare.py tests: loading, flattening, gating.
+
+Claims under test:
+ * all three artifact shapes load — the supervisor wrapper (metric line
+   under ``parsed``), a raw metric line, and a JSONL stream where the
+   last complete metric line wins;
+ * numeric scalars flatten to dot paths; bools and strings are skipped;
+ * the direction heuristic gates latencies lower-is-better and
+   throughput higher-is-better, leaves unknown names informational, and
+   treats ``live_retraces`` strictly (ANY increase fails, tolerance
+   ignored — a retrace storm is a bug, not noise);
+ * end to end: a regressed candidate exits non-zero, an improved or
+   within-tolerance one exits zero.
+"""
+
+import json
+
+import pytest
+
+from tools import bench_compare
+
+
+def _metric(value, detail):
+    return {"metric": "engine_req_per_s_per_chip", "value": value,
+            "unit": "req/s", "vs_baseline": value / 125.0,
+            "detail": detail}
+
+
+BASE = _metric(100.0, {
+    "decode_tokens_per_s": 10000.0, "p50_ttft_ms": 200.0,
+    "p99_ttft_ms": 400.0, "total_tokens": 40000,
+    "live_retraces": 0, "compile_variants": 9,
+    "device": "TPU v5 lite0", "partial": False,
+    "bench_1b": {"req_per_s": 140.0, "p50_ttft_ms": 900.0},
+})
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def test_load_supervisor_wrapper(tmp_path):
+    p = tmp_path / "wrapped.json"
+    p.write_text(json.dumps({"n": 5, "cmd": "python bench.py", "rc": 0,
+                             "tail": "...", "parsed": BASE}))
+    assert bench_compare.load_metric(str(p)) == BASE
+
+
+def test_load_raw_metric_line(tmp_path):
+    p = tmp_path / "raw.json"
+    p.write_text(json.dumps(BASE))
+    assert bench_compare.load_metric(str(p)) == BASE
+
+
+def test_load_jsonl_last_metric_wins(tmp_path):
+    p = tmp_path / "stream.jsonl"
+    partial = _metric(90.0, {"partial": True})
+    p.write_text("noise\n" + json.dumps(partial) + "\n"
+                 + json.dumps(BASE) + "\n{broken\n")
+    assert bench_compare.load_metric(str(p))["value"] == 100.0
+
+
+def test_load_no_metric_exits(tmp_path):
+    p = tmp_path / "empty.json"
+    p.write_text(json.dumps({"n": 4, "rc": 1, "parsed": None}))
+    with pytest.raises(SystemExit):
+        bench_compare.load_metric(str(p))
+
+
+# ---------------------------------------------------------------------------
+# Flattening + direction heuristic
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_numeric_scalars_only():
+    flat = bench_compare.flatten(BASE)
+    assert flat["value"] == 100.0
+    assert flat["detail.p50_ttft_ms"] == 200.0
+    assert flat["detail.bench_1b.req_per_s"] == 140.0
+    assert "detail.device" not in flat   # string
+    assert "detail.partial" not in flat  # bool
+
+
+def test_direction_heuristic():
+    d = bench_compare.direction
+    assert d("detail.p50_ttft_ms") == "lower"
+    assert d("detail.bench_1b.p99_ttft_ms") == "lower"
+    assert d("detail.pool_stalls") == "lower"
+    assert d("detail.decode_tokens_per_s") == "higher"
+    assert d("detail.bench_1b.req_per_s") == "higher"
+    assert d("detail.prefix.hit_rate") == "higher"
+    assert d("value") == "higher"
+    assert d("detail.bench_1b.vs_baseline") == "higher"
+    assert d("detail.live_retraces") == "strict"
+    assert d("detail.total_tokens") == "info"
+    assert d("detail.compile_variants") == "info"
+
+
+# ---------------------------------------------------------------------------
+# Gating
+# ---------------------------------------------------------------------------
+
+
+def test_within_tolerance_passes():
+    base = bench_compare.flatten(BASE)
+    cand = dict(base)
+    cand["value"] *= 0.95             # -5% on a 10% gate
+    cand["detail.p50_ttft_ms"] *= 1.08
+    _, regressions = bench_compare.compare(base, cand, tol=0.10)
+    assert regressions == []
+
+
+def test_throughput_drop_regresses():
+    base = bench_compare.flatten(BASE)
+    cand = dict(base)
+    cand["value"] *= 0.8
+    _, regressions = bench_compare.compare(base, cand, tol=0.10)
+    assert any(r.startswith("value:") for r in regressions)
+
+
+def test_latency_rise_regresses_and_fall_does_not():
+    base = bench_compare.flatten(BASE)
+    cand = dict(base)
+    cand["detail.p99_ttft_ms"] *= 1.5
+    cand["detail.p50_ttft_ms"] *= 0.5  # improvement, never gated
+    _, regressions = bench_compare.compare(base, cand, tol=0.10)
+    assert len(regressions) == 1
+    assert regressions[0].startswith("detail.p99_ttft_ms:")
+
+
+def test_live_retraces_strict_no_tolerance():
+    base = bench_compare.flatten(BASE)
+    cand = dict(base)
+    cand["detail.live_retraces"] = 1.0
+    _, regressions = bench_compare.compare(base, cand, tol=10.0)
+    assert any("live_retraces" in r for r in regressions)
+    # Equal or fewer retraces is fine.
+    cand["detail.live_retraces"] = 0.0
+    _, regressions = bench_compare.compare(base, cand, tol=10.0)
+    assert regressions == []
+
+
+def test_one_sided_metrics_are_informational():
+    base = bench_compare.flatten(BASE)
+    cand = {"value": 100.0}  # candidate lost every detail metric
+    lines, regressions = bench_compare.compare(base, cand, tol=0.10)
+    assert regressions == []
+    assert any("one-sided" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# End to end
+# ---------------------------------------------------------------------------
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    base_p = tmp_path / "base.json"
+    base_p.write_text(json.dumps(BASE))
+    good = _metric(101.0, dict(BASE["detail"]))
+    good_p = tmp_path / "good.json"
+    good_p.write_text(json.dumps(good))
+    assert bench_compare.main([str(base_p), str(good_p)]) == 0
+    assert "no regressions" in capsys.readouterr().out
+
+    bad_detail = dict(BASE["detail"])
+    bad_detail["live_retraces"] = 3
+    bad = _metric(100.0, bad_detail)
+    bad_p = tmp_path / "bad.json"
+    bad_p.write_text(json.dumps(bad))
+    assert bench_compare.main([str(base_p), str(bad_p)]) == 1
+    assert "strict" in capsys.readouterr().err

@@ -119,6 +119,25 @@ KNOBS = {
     "FLIGHT_RECORDER_SIZE": _k("runtime", "4096",
                                "Flight-recorder ring capacity (records); "
                                "older records are overwritten."),
+    "COMPILE_LEDGER": _k("runtime", "0",
+                         "Enable the compile ledger: every jitted engine "
+                         "entry point registers its static-shape variant "
+                         "key; post-warmup dispatches on undeclared keys "
+                         "are recorded as live-retrace witnesses. Served "
+                         "at /debug/compile; gated by `make "
+                         "compile-audit`."),
+    "HBM_LEDGER": _k("runtime", "0",
+                     "Enable the HBM ledger: weights / KV reservation / "
+                     "live KV / prefix cache / workspace live-byte "
+                     "accounting with high-watermarks, served at "
+                     "/debug/hbm and folded into probe_hbm."),
+    "DISPATCH_TIMING": _k("runtime", "0",
+                          "Per-variant dispatch duration histograms, "
+                          "measured at the scheduler's deliberate sync "
+                          "boundary; lands in EngineStats, Prometheus "
+                          "(jaxserver_dispatch_ms_*), and the flight "
+                          "recorder's dispatch records (per-variant "
+                          "Perfetto lanes via tools/trace_view.py)."),
     "TRACE_PROFILE_N": _k("runtime", "0",
                           "Capture a jax.profiler device trace over the "
                           "first N dispatched scheduler boundaries "

@@ -113,6 +113,22 @@ def paged_pool_report():
         f"{1.0 - written / (3 * 64):.1%} of 3 slots x 64 tokens)",
         flush=True,
     )
+    # With HBM_LEDGER=1 the engine carries the byte-level view of the
+    # same pool — fold it in so one probe run answers "where does HBM
+    # go" end to end (weights / reservation / live / workspace).
+    hbm = eng.debug_hbm()
+    if hbm is not None:
+        cats = hbm["categories"]
+        line = "  ".join(
+            f"{name}={cat['bytes']}B (hi {cat['high_bytes']}B)"
+            for name, cat in sorted(cats.items())
+        )
+        print(f"  hbm ledger: {line}", flush=True)
+        print(
+            f"  hbm total: {hbm['total_bytes']}B "
+            f"(hi {hbm['total_high_bytes']}B)",
+            flush=True,
+        )
 
 
 def main():

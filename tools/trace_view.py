@@ -19,7 +19,13 @@ Rendering model:
    drain, fail-all, profile markers) become "i" instants on the
    owning request's track (engine-wide ones on the scheduler track);
  * "boundary" records also emit a "C" counter series (`active_slots`)
-   so scheduler occupancy reads as a graph above the slices.
+   so scheduler occupancy reads as a graph above the slices;
+ * "dispatch" records (DISPATCH_TIMING=1) become "X" slices on a
+   second "variants" process — one lane per compile-ledger variant key
+   ("admit/32/4", "decode/8", ...), spanning dispatch -> boundary so
+   per-variant device occupancy reads directly off the track;
+ * "retrace" records (COMPILE_LEDGER=1) are the live-retrace
+   witnesses — rendered as instants on the paying request's track.
 
 Monotonic record timestamps convert to wall-clock microseconds via the
 snapshot's epoch pairing, so the device profile captured by
@@ -40,7 +46,10 @@ _TERMINAL = "terminal"
 _INSTANTS = (
     "trie-hit", "trie-miss", "cow", "preempt", "pool-stall", "chaos",
     "drain", "fail-all", "profile-start", "profile-stop", "shed",
+    "retrace",
 )
+# Per-variant dispatch lanes live on their own process row.
+_VARIANT_PID = 2
 
 
 def _wall_us(snapshot: Dict[str, Any], ts: float) -> float:
@@ -60,6 +69,25 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
     submit: Dict[int, Any] = {}
     admit: Dict[int, Any] = {}
     named: set = set()
+    # variant key -> lane tid on the variants process (pid 2), assigned
+    # in first-seen order so lanes are stable within one recording.
+    variant_tids: Dict[str, int] = {}
+
+    def variant_track(key: str) -> int:
+        tid = variant_tids.get(key)
+        if tid is None:
+            tid = len(variant_tids)
+            variant_tids[key] = tid
+            if tid == 0:
+                events.append({
+                    "ph": "M", "pid": _VARIANT_PID, "name": "process_name",
+                    "args": {"name": "seldon-tpu variants"},
+                })
+            events.append({
+                "ph": "M", "pid": _VARIANT_PID, "tid": tid,
+                "name": "thread_name", "args": {"name": key},
+            })
+        return tid
 
     def track(rid: int) -> int:
         if rid >= 0 and rid not in named:
@@ -105,6 +133,16 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                 })
             submit.pop(rid, None)
             admit.pop(rid, None)
+        elif kind == "dispatch":
+            # Recorded at boundary processing; the slice spans the wave
+            # backwards from there (ts is the sync point, ms the
+            # dispatch -> sync wall time).
+            key = str(detail.get("variant", "?"))
+            dur = max(float(detail.get("ms", 0.0)) * 1000.0, 0.1)
+            events.append({
+                "ph": "X", "pid": _VARIANT_PID, "tid": variant_track(key),
+                "name": key, "ts": ts - dur, "dur": dur, "args": detail,
+            })
         elif kind == "boundary":
             events.append({
                 "ph": "i", "pid": 1, "tid": 0, "name": "boundary",
