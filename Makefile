@@ -12,8 +12,11 @@ native:
 
 # Static invariants (docs/operations.md "Static invariants: graftlint"):
 # hot-sync, lock-guard, lockorder, retrace, outcome, env-knob vs the
-# checked-in baseline, plus a bytecode-compile sweep of the serving +
-# tools trees.
+# checked-in baseline, plus the graftflow dataflow trio (docs/operations.md
+# "Static dataflow: graftflow"): shape-lattice certification, the
+# (paged, chunked, prefix) config-reachability matrix with its dense-slab
+# kill-list, and the sharding-consistency rules — then a bytecode-compile
+# sweep of the serving + tools trees.
 lint:
 	python -m tools.graftlint
 	python -m compileall -q seldon_tpu tools
@@ -82,9 +85,11 @@ trace-smoke:
 # HBM_LEDGER + DISPATCH_TIMING on — asserts ZERO live retraces after
 # warmup, a dispatched-variant count within the budget, per-variant
 # timing reaching stats/recorder/trace_view, and the /debug/compile +
-# /debug/hbm schemas.
+# /debug/hbm schemas. --static-xcheck additionally proves the runtime
+# dispatch set is contained in graftflow's closed-form static lattice
+# (engine.static_lattice()) and that warmup declared exactly that set.
 compile-audit:
-	env JAX_PLATFORMS=cpu python -m tools.compile_audit
+	env JAX_PLATFORMS=cpu python -m tools.compile_audit --static-xcheck
 
 bench:
 	python bench.py

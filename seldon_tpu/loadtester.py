@@ -19,12 +19,15 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import logging
 import time
 from typing import List, Optional
 
 import numpy as np
 
 from seldon_tpu.core import tracing
+
+logger = logging.getLogger(__name__)
 
 
 async def _closed_loop(url_path: str, body: bytes, clients: int,
@@ -333,8 +336,11 @@ def _compile_counts(url: str) -> dict:
     compile ledger (COMPILE_LEDGER off -> the route 404s)."""
     import urllib.request
     try:
+        # Short timeout: this poll runs after the load window closed, so
+        # a server mid-drain may never answer — don't hold the ledger
+        # line hostage for it.
         with urllib.request.urlopen(
-            url.rstrip("/") + "/debug/compile", timeout=5
+            url.rstrip("/") + "/debug/compile", timeout=2
         ) as resp:
             comp = json.loads(resp.read())
         return {
@@ -342,9 +348,12 @@ def _compile_counts(url: str) -> dict:
             "live_retraces": int(comp["live_retrace_count"]),
             "compile_s_total": float(comp["compile_s_total"]),
         }
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError) as exc:
         # 404 (ledger off), connection teardown, or a foreign schema —
         # the ledger line simply goes without compile counters.
+        logger.debug("loadtester: /debug/compile poll failed (%s: %s) — "
+                     "ledger carries no compile counters",
+                     type(exc).__name__, exc)
         return {}
 
 

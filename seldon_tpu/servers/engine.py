@@ -44,7 +44,7 @@ from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
 from seldon_tpu.servers import compile_ledger, flight_recorder, graftsan
-from seldon_tpu.servers import hbm_ledger
+from seldon_tpu.servers import hbm_ledger, shape_lattice
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -1735,221 +1735,196 @@ class InferenceEngine:
             if n_swept:
                 logger.warning("shutdown swept %d unfinished requests", n_swept)
 
-    def warmup(self) -> None:
-        """Pre-compile every (prompt-bucket x group-size) admission variant
-        plus the decode chunk, so live traffic never eats a compile. Not
-        thread-safe against the scheduler: call before start() (or while no
-        requests are in flight)."""
-        # All-True keep mask: a pure compile of the lifecycle-reap freeze
-        # (identity on every row) so the first real cancel/deadline reap
-        # never eats a compile mid-traffic.
-        if self._observe:
-            t0 = time.perf_counter()
-        self._state = self._jit_deactivate(
-            self._state, jnp.ones((self.ecfg.max_slots,), jnp.bool_)
+    # --- static shape lattice -----------------------------------------------
+
+    def lattice_spec(self) -> shape_lattice.LatticeSpec:
+        """The shape-relevant slice of this engine's config, as consumed
+        by servers/shape_lattice.py — the single source of truth for
+        which static-shape keys exist (warmup iterates it, graftlint's
+        certifier cross-checks it, compile_audit --static-xcheck asserts
+        runtime dispatches stay inside it)."""
+        chunked = self._chunked
+        return shape_lattice.LatticeSpec(
+            buckets=self._buckets,
+            max_seq_len=self.ecfg.max_seq_len,
+            max_slots=self.ecfg.max_slots,
+            max_admit=self._max_admit,
+            decode_rungs=self._chunk_sizes,
+            paged=self._paged,
+            chunked=chunked,
+            prefix=(self._prefix is not None
+                    or self._paged_prefix is not None),
+            prefix_block=self.ecfg.prefix_block,
+            chunk_buckets=self._chunk_buckets if chunked else (),
+            prefill_chunk=self._prefill_chunk if chunked else 0,
+            token_budget=(
+                self.ecfg.dispatch_token_budget or self._prefill_chunk
+            ) if chunked else 0,
         )
-        if self._observe:
-            self._note_dispatch(("deactivate",), -1,
-                                time.perf_counter() - t0)
-        sizes = []
-        g = 1
-        while g <= self._max_admit:
-            sizes.append(g)
-            g *= 2
-        if self._chunked:
-            # Chunked engines never run the one-shot admission kernels;
-            # compile the (G x chunk-length x resident-width) chunk
-            # lattice instead, plus the per-width prefix seed scatters.
-            n_chunk_warm = self._warmup_chunked(sizes)
-            for n in self._chunk_sizes:
-                self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
-            if self._paged:
-                self._cow(0, 0)
-            jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
-            if self._cledger is not None:
-                self._cledger.warmup_done()
-            logger.info(
-                "engine warmed: %d prefill-chunk variants + %d decode "
-                "chunk sizes",
-                n_chunk_warm, len(self._chunk_sizes),
-            )
-            return
-        if self._paged:
-            # One paged admission kernel covers cold and warm; warm rows
-            # just gather through an all-trash table (pure compile). The
-            # shared CoW copy compiles once (traced src/dst scalars).
-            widths = (0,)
-            if self._paged_prefix is not None:
-                widths += tuple(
-                    b for b in self._buckets if b < self.ecfg.max_seq_len
-                )
-            n_warm = 0
-            for Sb in self._buckets:
-                for G in sizes:
-                    table = jnp.zeros((G, self._nbs), jnp.int32)
-                    for W in widths:
-                        if self._observe:
-                            t0 = time.perf_counter()
-                        self._state, _, _ = self._jit_admit_paged(
-                            self.params,
-                            self._state,
-                            table,
-                            jnp.zeros((G, Sb), jnp.int32),
-                            jnp.full((G,), W + 1, jnp.int32),
-                            jnp.full((G,), W, jnp.int32),
-                            jnp.zeros((G,), jnp.uint32),
-                            jnp.ones((G,), jnp.float32),
-                            jnp.zeros((G,), jnp.int32),
-                            jnp.ones((G,), jnp.float32),
-                            jnp.ones((G,), jnp.int32),
-                            jnp.arange(G, dtype=jnp.int32),
-                            prefix_width=W,
-                        )
-                        if self._observe:
-                            self._note_dispatch(
-                                ("admit-paged", Sb, G, W), -1,
-                                time.perf_counter() - t0,
-                            )
-                        n_warm += 1
-            self._cow(0, 0)
-            for n in self._chunk_sizes:
-                self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
-            jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
-            if self._cledger is not None:
-                self._cledger.warmup_done()
-            logger.info(
-                "engine warmed (paged): %d admission variants + %d decode "
-                "chunk sizes",
-                n_warm, len(self._chunk_sizes),
-            )
-            return
-        admit = self._jit_admit_sub if self._prefix is not None \
-            else self._jit_admit
-        n_warm = 0
-        for Sb in self._buckets:
-            for G in sizes:
-                # max_new=1 -> rows are first_done; no slot state leaks.
-                if self._observe:
-                    t0 = time.perf_counter()
-                out = admit(
-                    self.params,
-                    self._state,
-                    jnp.zeros((G, Sb), jnp.int32),
-                    jnp.ones((G,), jnp.int32),
-                    jnp.zeros((G,), jnp.uint32),
-                    jnp.ones((G,), jnp.float32),
-                    jnp.zeros((G,), jnp.int32),
-                    jnp.ones((G,), jnp.float32),
-                    jnp.ones((G,), jnp.int32),
-                    jnp.arange(G, dtype=jnp.int32),
-                )
-                self._state = out[0]
-                if self._observe:
-                    self._note_dispatch(("admit", Sb, G), -1,
-                                        time.perf_counter() - t0)
-                if self._prefix is not None:
-                    # Warm (prefix-hit) variants: one per
-                    # (prefix bucket, suffix bucket, G). Zero prefix KV +
-                    # max_new=1 keeps it a pure compile.
-                    for Pb in self._buckets:
-                        if Pb >= self.ecfg.max_seq_len:
-                            continue
-                        pkv = transformer.init_cache(self.cfg, G, Pb)
-                        if self._observe:
-                            t0 = time.perf_counter()
-                        self._state, _, _, _ = self._jit_admit_prefix(
-                            self.params,
-                            self._state,
-                            jnp.zeros((G, Sb), jnp.int32),
-                            jnp.full((G,), Pb + 1, jnp.int32),
-                            jnp.full((G,), Pb, jnp.int32),
-                            pkv,
-                            jnp.zeros((G,), jnp.uint32),
-                            jnp.ones((G,), jnp.float32),
-                            jnp.zeros((G,), jnp.int32),
-                            jnp.ones((G,), jnp.float32),
-                            jnp.ones((G,), jnp.int32),
-                            jnp.arange(G, dtype=jnp.int32),
-                        )
-                        if self._observe:
-                            self._note_dispatch(
-                                ("admit-prefix", Pb, Sb, G), -1,
-                                time.perf_counter() - t0,
-                            )
-                        n_warm += 1
-        # All slots inactive: pure compile + masked no-op writes, one per
-        # chunk-ladder rung.
-        for n in self._chunk_sizes:
-            self._state, _, _, _ = self._dispatch_decode_chunk(n)  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
+
+    def static_lattice(self) -> List[str]:
+        """Canonical key strings of every variant live scheduling can
+        dispatch — the /debug/compile "declared" set, exported so audits
+        can compare against the runtime lattice without a ledger."""
+        keys = shape_lattice.dispatch_keys(self.lattice_spec())
+        return [compile_ledger.key_str(k)
+                for k in shape_lattice.warmup_order(keys)]
+
+    def warmup(self) -> None:
+        """Pre-compile the full static shape lattice, so live traffic
+        never eats a compile. The key set comes from lattice_spec() —
+        the same closed form graftlint certifies against the scheduler
+        arithmetic — so warmup covers exactly what live scheduling can
+        dispatch: every reachable key (no live retraces, including the
+        top-bucket == max_seq_len widths the old per-mode loops skipped)
+        and no unreachable ones (no wasted prefill compiles). Not
+        thread-safe against the scheduler: call before start() (or while
+        no requests are in flight)."""
+        keys = shape_lattice.warmup_order(
+            shape_lattice.dispatch_keys(self.lattice_spec())
+        )
+        if self._cledger is not None:
+            # Declare ahead of dispatching: a warmup crash mid-lattice
+            # still leaves /debug/compile showing the full intended set.
+            for key in keys:
+                self._cledger.declare(key)
+        for key in keys:
+            self._warm_key(key)
         jax.block_until_ready(self._state["last_tok"])  # graftlint: allow(hot-sync) warmup runs before start(); the sync IS the point
         if self._cledger is not None:
             self._cledger.warmup_done()
         logger.info(
-            "engine warmed: %d admission variants (+%d prefix-warm) + %d "
-            "decode chunk sizes",
-            len(self._buckets) * len(sizes), n_warm, len(self._chunk_sizes),
+            "engine warmed: %d lattice variants across %d families",
+            len(keys), len({k[0] for k in keys}),
         )
 
-    def _warmup_chunked(self, sizes: List[int]) -> int:
-        """Compile every (group size x chunk length x resident prefix
-        width) chunk variant + the prefix-seed scatters. Widths cover 0
-        (a prompt's first chunk, cold) and each prompt-bucket rung (any
-        later chunk's bucketed start). max_new=1 keeps each call a pure
-        compile: rows finish immediately, no slot state leaks."""
+    def _warm_key(self, key: Tuple[Any, ...]) -> None:
+        """Compile ONE lattice key: build zero-filled arrays of the
+        key's static shapes and dispatch the matching jit entry point.
+        max_new=1 everywhere -> rows are first_done; no slot state
+        leaks. Traced scalars (plens/pref/starts) are clamped into the
+        cache window — for top-bucket keys the bucket equals
+        max_seq_len, so the nominal width+1 would index past it; the
+        clamp only changes traced VALUES, never the static key."""
+        kind = key[0]
         Smax = self.ecfg.max_seq_len
-        widths = (0,) + tuple(b for b in self._buckets if b < Smax)
-        n = 0
-        for G in sizes:
-            for Sc in self._chunk_buckets:
-                for W in widths:
-                    starts = jnp.full((G,), W, jnp.int32)
-                    args = (
-                        jnp.zeros((G, Sc), jnp.int32),
-                        jnp.full((G,), W + Sc, jnp.int32),
-                        starts,
-                        jnp.zeros((G,), jnp.uint32),
-                        jnp.ones((G,), jnp.float32),
-                        jnp.zeros((G,), jnp.int32),
-                        jnp.ones((G,), jnp.float32),
-                        jnp.ones((G,), jnp.int32),
-                        jnp.arange(G, dtype=jnp.int32),
-                        jnp.ones((G,), jnp.bool_),
-                    )
-                    if self._observe:
-                        t0 = time.perf_counter()
-                    if self._paged:
-                        # All-trash tables keep the compile a no-op write.
-                        out = self._jit_admit_chunk_paged(
-                            self.params,
-                            self._state,
-                            jnp.zeros((G, self._nbs), jnp.int32),
-                            *args,
-                            prefix_width=W,
-                        )
-                    else:
-                        out = self._jit_admit_chunk(
-                            self.params, self._state, *args,
-                            prefix_width=W,
-                        )
-                    self._state = out[0]
-                    if self._observe:
-                        self._note_dispatch(("chunk", Sc, G, W), -1,
-                                            time.perf_counter() - t0)
-                    n += 1
-        if self._jit_seed_prefix is not None:
-            for W in widths[1:]:
-                pkv_full = transformer.init_cache(self.cfg, 1, W)
-                pkv = {key: pkv_full[key][:, 0] for key in pkv_full}
-                if self._observe:
-                    t0 = time.perf_counter()
-                self._state = self._jit_seed_prefix(
-                    self._state, pkv, jnp.int32(0)
+        if self._observe:
+            t0 = time.perf_counter()
+        if kind == "decode":
+            # _dispatch_decode_chunk notes its own dispatch key.
+            self._state, _, _, _ = self._dispatch_decode_chunk(key[1])  # graftlint: allow(holds-site) warmup runs before start(); no scheduler thread exists yet
+            return
+        if kind == "cow" and self._paged:
+            # _cow notes its own dispatch key (traced src/dst scalars).
+            self._cow(0, 0)
+            return
+        if kind == "deactivate":
+            # All-True keep mask: identity freeze, so the first real
+            # cancel/deadline reap never eats a compile mid-traffic.
+            self._state = self._jit_deactivate(
+                self._state, jnp.ones((self.ecfg.max_slots,), jnp.bool_)
+            )
+        elif kind == "admit" and not self._paged:
+            _, Sb, G = key
+            admit = self._jit_admit_sub if self._prefix is not None \
+                else self._jit_admit
+            out = admit(
+                self.params,
+                self._state,
+                jnp.zeros((G, Sb), jnp.int32),
+                jnp.ones((G,), jnp.int32),
+                jnp.zeros((G,), jnp.uint32),
+                jnp.ones((G,), jnp.float32),
+                jnp.zeros((G,), jnp.int32),
+                jnp.ones((G,), jnp.float32),
+                jnp.ones((G,), jnp.int32),
+                jnp.arange(G, dtype=jnp.int32),
+            )
+            self._state = out[0]
+        elif kind == "admit-prefix" and self._prefix is not None:
+            # Warm (prefix-hit) variant: zero prefix KV keeps it a pure
+            # compile.
+            _, Pb, Sb, G = key
+            pkv = transformer.init_cache(self.cfg, G, Pb)
+            pref = min(Pb, Smax - 1)
+            self._state, _, _, _ = self._jit_admit_prefix(
+                self.params,
+                self._state,
+                jnp.zeros((G, Sb), jnp.int32),
+                jnp.full((G,), pref + 1, jnp.int32),
+                jnp.full((G,), pref, jnp.int32),
+                pkv,
+                jnp.zeros((G,), jnp.uint32),
+                jnp.ones((G,), jnp.float32),
+                jnp.zeros((G,), jnp.int32),
+                jnp.ones((G,), jnp.float32),
+                jnp.ones((G,), jnp.int32),
+                jnp.arange(G, dtype=jnp.int32),
+            )
+        elif kind == "admit-paged" and self._paged:
+            # One paged admission kernel covers cold and warm; warm rows
+            # just gather through an all-trash table (pure compile).
+            _, Sb, G, W = key
+            pref = min(W, Smax - 1)
+            self._state, _, _ = self._jit_admit_paged(
+                self.params,
+                self._state,
+                jnp.zeros((G, self._nbs), jnp.int32),
+                jnp.zeros((G, Sb), jnp.int32),
+                jnp.full((G,), pref + 1, jnp.int32),
+                jnp.full((G,), pref, jnp.int32),
+                jnp.zeros((G,), jnp.uint32),
+                jnp.ones((G,), jnp.float32),
+                jnp.zeros((G,), jnp.int32),
+                jnp.ones((G,), jnp.float32),
+                jnp.ones((G,), jnp.int32),
+                jnp.arange(G, dtype=jnp.int32),
+                prefix_width=W,
+            )
+        elif kind == "chunk" and self._chunked:
+            _, Sc, G, W = key
+            start = min(W, Smax - Sc)
+            args = (
+                jnp.zeros((G, Sc), jnp.int32),
+                jnp.full((G,), start + Sc, jnp.int32),
+                jnp.full((G,), start, jnp.int32),
+                jnp.zeros((G,), jnp.uint32),
+                jnp.ones((G,), jnp.float32),
+                jnp.zeros((G,), jnp.int32),
+                jnp.ones((G,), jnp.float32),
+                jnp.ones((G,), jnp.int32),
+                jnp.arange(G, dtype=jnp.int32),
+                jnp.ones((G,), jnp.bool_),
+            )
+            if self._paged:
+                # All-trash tables keep the compile a no-op write.
+                out = self._jit_admit_chunk_paged(
+                    self.params,
+                    self._state,
+                    jnp.zeros((G, self._nbs), jnp.int32),
+                    *args,
+                    prefix_width=W,
                 )
-                if self._observe:
-                    self._note_dispatch(("seed-prefix", W), -1,
-                                        time.perf_counter() - t0)
-                n += 1
-        return n
+            else:
+                out = self._jit_admit_chunk(
+                    self.params, self._state, *args, prefix_width=W,
+                )
+            self._state = out[0]
+        elif kind == "seed-prefix" and self._jit_seed_prefix is not None:
+            W = key[1]
+            pkv_full = transformer.init_cache(self.cfg, 1, W)
+            pkv = {k: pkv_full[k][:, 0] for k in pkv_full}
+            self._state = self._jit_seed_prefix(
+                self._state, pkv, jnp.int32(0)
+            )
+        else:
+            raise ValueError(
+                f"lattice key {key!r} has no warm recipe for this "
+                f"config — shape_lattice.dispatch_keys and _warm_key "
+                f"have drifted"
+            )
+        if self._observe:
+            self._note_dispatch(key, -1, time.perf_counter() - t0)  # graftlint: allow(shape-lattice) key IS a lattice key — _warm_key iterates dispatch_keys()
 
     # --- compile/device observatory taps ------------------------------------
 

@@ -146,7 +146,20 @@ def test_one_sided_metrics_are_informational():
     cand = {"value": 100.0}  # candidate lost every detail metric
     lines, regressions = bench_compare.compare(base, cand, tol=0.10)
     assert regressions == []
-    assert any("one-sided" in ln for ln in lines)
+    # Metrics only in base were removed by the candidate run.
+    assert any("(removed)" in ln for ln in lines)
+    assert not any("(added)" in ln for ln in lines)
+
+
+def test_one_sided_reports_which_side():
+    base = bench_compare.flatten(BASE)
+    cand = dict(base)
+    del cand["detail.p99_ttft_ms"]         # dropped by the candidate
+    cand["detail.new_counter"] = 7.0       # introduced by the candidate
+    lines, regressions = bench_compare.compare(base, cand, tol=0.10)
+    assert regressions == []
+    assert any("p99_ttft_ms" in ln and "(removed)" in ln for ln in lines)
+    assert any("new_counter" in ln and "(added)" in ln for ln in lines)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,121 @@
+"""Frozen-schema golden tests for the debug observatory snapshots.
+
+``/debug/compile`` and ``/debug/hbm`` are consumed by at least four
+parties that never import this repo's dataclasses: the loadtester's
+ledger poll, ``tools/compile_audit.py``, ``tools/probe_hbm``, and
+whatever dashboards operators curl together.  Their schemas are frozen
+here as literal key sets.  If one of these tests fails, you changed the
+wire contract: update the module docstrings in
+``seldon_tpu/servers/compile_ledger.py`` / ``hbm_ledger.py``, the
+consumers above, AND these goldens in the same PR — never just the
+golden.
+"""
+
+import json
+
+from seldon_tpu.servers.compile_ledger import CompileLedger
+from seldon_tpu.servers.hbm_ledger import HbmLedger
+
+# The documented /debug/compile schema, frozen.
+COMPILE_TOP_KEYS = frozenset({
+    "warmup_complete",
+    "declared_variants",
+    "dispatched_variants",
+    "warmup_coverage",
+    "compile_s_total",
+    "live_retrace_count",
+    "live_retraces",
+    "lattice",
+})
+COMPILE_WITNESS_KEYS = frozenset({"key", "rid", "compile_ms", "ts"})
+COMPILE_LATTICE_KEYS = frozenset({
+    "key", "dispatches", "first_dispatch_ms", "declared",
+})
+
+# The documented /debug/hbm schema, frozen.
+HBM_TOP_KEYS = frozenset({"categories", "total_bytes", "total_high_bytes"})
+HBM_CATEGORY_KEYS = frozenset({"bytes", "high_bytes", "static"})
+
+
+def _populated_compile_ledger() -> CompileLedger:
+    """A ledger exercising every snapshot branch: declared + dispatched
+    keys, a sealed lattice, and one live-retrace witness."""
+    led = CompileLedger()
+    led.declare(("admit", 64, 4, 1))
+    led.dispatch(("admit", 64, 4, 1), rid=-1, seconds=0.5)
+    led.dispatch(("decode", 8), rid=-1, seconds=0.2)
+    led.warmup_done()
+    led.dispatch(("admit", 64, 4, 1), rid=1, seconds=0.001)  # cache hit
+    witness = led.dispatch(("admit", 128, 8, 1), rid=2, seconds=0.7)
+    assert witness is not None  # undeclared post-seal => live retrace
+    return led
+
+
+def _populated_hbm_ledger() -> HbmLedger:
+    led = HbmLedger()
+    led.set_static("weights", 1 << 20)
+    led.set_static("kv_cache", 1 << 18)
+    led.gauge("kv_live", lambda: 4096)
+    led.note_workspace(2048)
+    return led
+
+
+def test_compile_snapshot_key_set_is_frozen():
+    snap = _populated_compile_ledger().snapshot()
+    assert set(snap) == COMPILE_TOP_KEYS
+    assert snap["live_retraces"], "fixture must produce a witness"
+    for w in snap["live_retraces"]:
+        assert set(w) == COMPILE_WITNESS_KEYS
+    assert snap["lattice"], "fixture must produce lattice entries"
+    for entry in snap["lattice"]:
+        assert set(entry) == COMPILE_LATTICE_KEYS
+
+
+def test_compile_snapshot_value_kinds():
+    snap = _populated_compile_ledger().snapshot()
+    assert isinstance(snap["warmup_complete"], bool)
+    assert isinstance(snap["declared_variants"], int)
+    assert isinstance(snap["dispatched_variants"], int)
+    assert isinstance(snap["warmup_coverage"], float)
+    assert isinstance(snap["compile_s_total"], float)
+    assert isinstance(snap["live_retrace_count"], int)
+    for entry in snap["lattice"]:
+        # Keys render as the canonical slash-joined string, not tuples.
+        assert isinstance(entry["key"], str) and "/" in entry["key"]
+        assert isinstance(entry["declared"], bool)
+
+
+def test_compile_snapshot_empty_ledger_same_keys():
+    # A never-touched ledger serves the SAME key set (consumers need no
+    # existence checks), just with empty/zero values.
+    snap = CompileLedger().snapshot()
+    assert set(snap) == COMPILE_TOP_KEYS
+    assert snap["lattice"] == [] and snap["live_retraces"] == []
+
+
+def test_hbm_snapshot_key_set_is_frozen():
+    snap = _populated_hbm_ledger().snapshot()
+    assert set(snap) == HBM_TOP_KEYS
+    assert snap["categories"], "fixture must produce categories"
+    for cat in snap["categories"].values():
+        assert set(cat) == HBM_CATEGORY_KEYS
+
+
+def test_hbm_snapshot_value_kinds():
+    snap = _populated_hbm_ledger().snapshot()
+    cats = snap["categories"]
+    assert cats["weights"]["static"] is True
+    assert cats["kv_live"]["static"] is False
+    assert cats["workspace"]["static"] is False
+    assert isinstance(snap["total_bytes"], int)
+    assert isinstance(snap["total_high_bytes"], int)
+    assert snap["total_bytes"] == sum(c["bytes"] for c in cats.values())
+
+
+def test_snapshots_are_json_clean():
+    # Both snapshots must survive json.dumps untouched — they go over
+    # the wire verbatim from the debug routes.
+    comp = json.loads(json.dumps(_populated_compile_ledger().snapshot()))
+    assert set(comp) == COMPILE_TOP_KEYS
+    hbm = json.loads(json.dumps(_populated_hbm_ledger().snapshot()))
+    assert set(hbm) == HBM_TOP_KEYS

@@ -120,8 +120,11 @@ def compare(base: Dict[str, float], cand: Dict[str, float],
         b, c = base.get(path), cand.get(path)
         d = direction(path)
         if b is None or c is None:
+            # Say WHICH side is missing: a metric only in cand was added
+            # by the candidate run; one only in base was removed by it.
+            status = "added" if b is None else "removed"
             lines.append(f"{path:<44} {_fmt(b):>12} {_fmt(c):>12} "
-                         f"{'--':>8}  {d} (one-sided)")
+                         f"{'--':>8}  {d} ({status})")
             continue
         delta = (c - b) / abs(b) if b else (0.0 if c == b else float("inf"))
         verdict = d

@@ -19,12 +19,20 @@ run, then asserts the observatory contract in one pass:
  * ``/debug/hbm`` returns the documented schema with non-zero weight
    and KV-reservation bytes.
 
+With ``--static-xcheck`` the audit additionally cross-checks the
+runtime against graftflow's closed-form model: every key the engine
+actually dispatched must be a member of ``engine.static_lattice()``
+(the ``shape_lattice.dispatch_keys`` enumeration), and the declared
+variant count must equal the static lattice size — i.e. warmup
+declared exactly the statically-certified set, nothing ad hoc.
+
 Run via ``make compile-audit`` (wired into ``make ci``); exits non-zero
 with a one-line diagnosis on the first failed check.
 """
 
 from __future__ import annotations
 
+import argparse
 import contextlib
 import io
 import json
@@ -44,7 +52,15 @@ def _check(cond: bool, msg: str) -> None:
         raise SystemExit(1)
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.compile_audit")
+    ap.add_argument(
+        "--static-xcheck", action="store_true",
+        help="also assert the runtime-dispatched key set is contained in "
+             "engine.static_lattice() and that warmup declared exactly "
+             "the static lattice (graftflow's closed-form model)")
+    args = ap.parse_args(argv)
+
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ["COMPILE_LEDGER"] = "1"
     os.environ["HBM_LEDGER"] = "1"
@@ -134,6 +150,25 @@ def main() -> int:
     undeclared = [e["key"] for e in comp["lattice"] if not e["declared"]]
     _check(not undeclared, f"undeclared lattice keys: {undeclared}")
 
+    # --- --static-xcheck: runtime vs graftflow's closed-form lattice ----
+    static_size = None
+    if args.static_xcheck:
+        static = set(srv.engine.static_lattice())
+        static_size = len(static)
+        dispatched = {e["key"] for e in comp["lattice"]}
+        rogue = sorted(dispatched - static)
+        _check(
+            not rogue,
+            f"runtime dispatched {len(rogue)} key(s) outside the static "
+            f"lattice: {rogue}",
+        )
+        _check(
+            comp["declared_variants"] == static_size,
+            f"warmup declared {comp['declared_variants']} variants but "
+            f"the static lattice holds {static_size} — warmup and "
+            f"shape_lattice.dispatch_keys have drifted apart",
+        )
+
     # --- loadtester ledger carries the compile counters -----------------
     _check(
         detail.get("compile_variants") == comp["dispatched_variants"],
@@ -186,6 +221,7 @@ def main() -> int:
             "warmup_coverage": comp["warmup_coverage"],
             "variant_lanes": sorted(lanes),
             "hbm_total_bytes": hbm["total_bytes"],
+            "static_lattice": static_size,
         },
     }))
     return 0

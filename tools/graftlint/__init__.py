@@ -1,15 +1,26 @@
 """graftlint — project-specific static analysis for the seldon-tpu tree.
 
-Five composable AST passes enforce the invariants the chaos soak can only
-sample dynamically:
+Composable AST/dataflow passes enforce the invariants the chaos soak can
+only sample dynamically:
 
-  hot-sync     no host synchronisation inside the scheduler dispatch loop
-  lock-guard   fields declared ``# graftlint: guarded-by(<lock>)`` are only
-               touched under ``with self.<lock>:``
-  retrace      jitted functions must not pick up per-request Python state
-               that forces recompiles
-  outcome      request finalization emits exactly one terminal item
-  env-knob     every env var read appears in the generated knob table
+  hot-sync       no host synchronisation inside the scheduler dispatch loop
+  lock-guard     fields declared ``# graftlint: guarded-by(<lock>)`` are
+                 only touched under ``with self.<lock>:``
+  retrace        jitted functions must not pick up per-request Python state
+                 that forces recompiles
+  outcome        request finalization emits exactly one terminal item
+  env-knob       every env var read appears in the generated knob table
+
+plus the graftflow dataflow trio (docs/operations.md "Static dataflow:
+graftflow"):
+
+  shape-lattice  warmup's closed-form variant lattice must equal the
+                 operationally dispatchable key set (static retrace proof
+                 / warmup-waste detection)
+  config-matrix  per-method (paged, chunked, prefix) reachability; emits
+                 docs/config_matrix.md + the dense-slab kill-list
+  shard-*        PartitionSpec/collective axis names vs mesh.AXES, host
+                 pulls on sharded arrays, sharding-dropping jit boundaries
 
 Run as ``python -m tools.graftlint seldon_tpu tools``.  Accepted findings
 live in ``graftlint_baseline.json``; CI fails only on regressions.

@@ -10,12 +10,13 @@ import sys
 from pathlib import Path
 from typing import List
 
-from . import hotpath, knobs, lockorder, locks, outcome, retrace
+from . import (configmatrix, hotpath, knobs, lockorder, locks, outcome,
+               retrace, shapelattice, shardcheck)
 from .core import (Context, Finding, PLACEHOLDER_NOTE, load_baseline,
                    load_tree, run_passes, write_baseline)
 
 PASSES = [hotpath.run, locks.run, lockorder.run, retrace.run, outcome.run,
-          knobs.run]
+          knobs.run, shapelattice.run, configmatrix.run, shardcheck.run]
 
 
 def _repo_root() -> Path:
@@ -35,7 +36,8 @@ def main(argv: List[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="seldon-tpu invariant checker (hot-sync, lock-guard, "
-                    "lockorder, retrace, outcome, env-knob)")
+                    "lockorder, retrace, outcome, env-knob, shape-lattice, "
+                    "config-matrix, shard-axis/-host-pull/-jit)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files/dirs to lint (default: seldon_tpu tools "
                          "bench.py bench_orchestrator.py "
@@ -50,6 +52,8 @@ def main(argv: List[str] | None = None) -> int:
                     help="report findings without baseline suppression")
     ap.add_argument("--gen-knobs", action="store_true",
                     help="regenerate docs/knobs.md and exit")
+    ap.add_argument("--gen-config-matrix", action="store_true",
+                    help="regenerate docs/config_matrix.md and exit")
     args = ap.parse_args(argv)
 
     if args.write_baseline and not (args.note and args.note.strip()):
@@ -74,7 +78,27 @@ def main(argv: List[str] | None = None) -> int:
         print(f"graftlint: wrote {ctx.knobs_doc.relative_to(root)}")
         return 0
 
+    if args.gen_config_matrix:
+        model = configmatrix.analyze(files)
+        if model is None:
+            print("graftlint: no engine-like class (warmup + submit) in "
+                  "the scan set", file=sys.stderr)
+            return 2
+        ctx.matrix_doc.parent.mkdir(parents=True, exist_ok=True)
+        ctx.matrix_doc.write_text(configmatrix.generate_matrix_md(model))
+        print(f"graftlint: wrote {ctx.matrix_doc.relative_to(root)}")
+        return 0
+
     findings = run_passes(files, ctx, PASSES)
+
+    # graftflow headline for CI logs: the dense-slab kill-list size is
+    # the ROADMAP item-2 progress needle (acceptance wants it visible).
+    model = configmatrix.analyze(files)
+    if model is not None:
+        kill = model.kill_list()
+        print(f"graftflow: dense-slab kill-list: {len(kill)} method(s) "
+              f"reachable only with paged_kv=False "
+              f"(docs/config_matrix.md)")
 
     baseline = {} if args.no_baseline else load_baseline(ctx.baseline_path)
     if args.write_baseline:

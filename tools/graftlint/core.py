@@ -85,6 +85,7 @@ class Context:
         self.repo_root = repo_root
         self.baseline_path = repo_root / BASELINE_NAME
         self.knobs_doc = repo_root / "docs" / "knobs.md"
+        self.matrix_doc = repo_root / "docs" / "config_matrix.md"
 
 
 def _parse_controls(lines: Sequence[str]):

@@ -1,0 +1,219 @@
+"""Shape-lattice certifier: static proof that warmup covers exactly the
+dispatchable variant set.
+
+Two legs, one rule family:
+
+AST leg (any scanned file) — every ``self._note_dispatch((<key>), ...)``
+site must use a tuple-literal key whose family tag is a string constant
+registered in ``shape_lattice.FAMILIES`` with the registered arity, and
+the ``_warm_key`` dispatcher must carry a handler comparison for every
+family the dispatch sites use.  This pins the engine's dispatch-site
+spellings to the closed-form model: a new jit entry point that skips the
+model registration is a lint error before it ever runs.
+
+Numeric leg (full-tree runs only — gated on BOTH ``servers/engine.py``
+and ``servers/shape_lattice.py`` being in the scan set, the knobs-pass
+registry idiom) — run :func:`shape_lattice.check_spec` over the
+representative config grid and compare the two independently written
+derivations of the lattice:
+
+ * a key the operational simulation reaches but the closed form misses
+   is a **statically proven live retrace** (warmup iterates the closed
+   form, so it would skip the key) -> ``shape-lattice`` error;
+ * a closed-form key the exhaustive simulation never produces is
+   **warmup waste** (a multi-second prefill compile no request can
+   reach) -> ``shape-lattice-waste``.
+
+The runtime third leg lives in ``tools/compile_audit.py
+--static-xcheck``: on the warmed tiny server, every runtime-dispatched
+key must be inside ``engine.static_lattice()``.
+
+Waive with ``# graftlint: allow(shape-lattice) why`` /
+``allow(shape-lattice-waste)`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint import core
+
+RULE = "shape-lattice"
+RULE_WASTE = "shape-lattice-waste"
+
+ENGINE_REL = "seldon_tpu/servers/engine.py"
+MODEL_REL = "seldon_tpu/servers/shape_lattice.py"
+
+
+def _families() -> Dict[str, int]:
+    from seldon_tpu.servers import shape_lattice
+
+    return dict(shape_lattice.FAMILIES)
+
+
+def _check_grid() -> List[Tuple[str, List[tuple], List[tuple]]]:
+    """(spec label, holes, waste) per grid spec — the closed-form vs
+    operational cross-check. Separated out so tests can monkeypatch a
+    disagreement in."""
+    from seldon_tpu.servers import shape_lattice
+
+    out = []
+    for spec in shape_lattice.grid():
+        holes, waste = shape_lattice.check_spec(spec)
+        label = "".join((
+            "P" if spec.paged else "-",
+            "C" if spec.chunked else "-",
+            "X" if spec.prefix else "-",
+        )) + f" buckets={spec.buckets} smax={spec.max_seq_len}"
+        out.append((label, holes, waste))
+    return out
+
+
+def _key_tuple(call: ast.Call) -> Optional[ast.expr]:
+    """The key argument of a self._note_dispatch(...) call, else None."""
+    fn = call.func
+    if (isinstance(fn, ast.Attribute) and fn.attr == "_note_dispatch"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "self"
+            and call.args):
+        return call.args[0]
+    return None
+
+
+def _dispatch_sites(sf: core.SourceFile):
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            key = _key_tuple(node)
+            if key is not None:
+                yield node, key
+
+
+def _warm_key_families(sf: core.SourceFile) -> Optional[Tuple[int, Set[str]]]:
+    """(def line, family tags compared) for a _warm_key def, if any.
+    A handler is any ``== "family"`` comparison inside the function —
+    the dispatcher's if/elif chain on the key's tag."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_warm_key":
+            handled: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Compare):
+                    for cmp in [sub.left] + list(sub.comparators):
+                        if (isinstance(cmp, ast.Constant)
+                                and isinstance(cmp.value, str)):
+                            handled.add(cmp.value)
+            return node.lineno, handled
+    return None
+
+
+def run(files: List[core.SourceFile], ctx: core.Context) -> List[core.Finding]:
+    findings: List[core.Finding] = []
+    families = _families()
+
+    # -- AST leg: dispatch-site keys vs the registered family table ----------
+    site_families: Dict[str, Set[str]] = {}
+    for sf in files:
+        core.attach_parents(sf.tree)
+        for call, key in _dispatch_sites(sf):
+            ln = call.lineno
+            qn = core.qualname_of(call)
+            if core.allowed(sf, RULE, ln):
+                continue
+            if not isinstance(key, ast.Tuple) or not key.elts:
+                findings.append(core.make_finding(
+                    sf, RULE, ln,
+                    "_note_dispatch key is not a non-empty tuple literal "
+                    "— the certifier cannot tie this site to the static "
+                    "lattice",
+                    hint="spell the key inline: "
+                         "self._note_dispatch((\"family\", ...), ...)",
+                    qualname=qn,
+                ))
+                continue
+            tag = key.elts[0]
+            if not (isinstance(tag, ast.Constant)
+                    and isinstance(tag.value, str)):
+                findings.append(core.make_finding(
+                    sf, RULE, ln,
+                    "_note_dispatch key family tag is not a string "
+                    "constant",
+                    hint="the first tuple element names the variant "
+                         "family statically",
+                    qualname=qn,
+                ))
+                continue
+            fam = tag.value
+            site_families.setdefault(sf.rel, set()).add(fam)
+            if fam not in families:
+                findings.append(core.make_finding(
+                    sf, RULE, ln,
+                    f"dispatch key family \"{fam}\" is not registered in "
+                    f"shape_lattice.FAMILIES — warmup and the static "
+                    f"certifier cannot see it",
+                    hint="register the family (and its key arity) in "
+                         "seldon_tpu/servers/shape_lattice.py and teach "
+                         "dispatch_keys()/simulate_keys() its domain",
+                    qualname=qn,
+                ))
+            elif len(key.elts) != families[fam]:
+                findings.append(core.make_finding(
+                    sf, RULE, ln,
+                    f"dispatch key family \"{fam}\" has {len(key.elts)} "
+                    f"components here but FAMILIES registers "
+                    f"{families[fam]}",
+                    hint="a drifting key arity means the ledger and the "
+                         "static lattice key different variants",
+                    qualname=qn,
+                ))
+
+    # -- AST leg: _warm_key must handle every family its file dispatches -----
+    for sf in files:
+        wk = _warm_key_families(sf)
+        if wk is None:
+            continue
+        def_line, handled = wk
+        used = site_families.get(sf.rel, set()) & set(families)
+        missing = sorted(used - handled)
+        if missing and not core.allowed(sf, RULE, def_line):
+            findings.append(core.make_finding(
+                sf, RULE, def_line,
+                f"_warm_key has no handler comparison for dispatch "
+                f"famil{'y' if len(missing) == 1 else 'ies'} "
+                f"{', '.join(missing)} — warmup would raise on a "
+                f"lattice key it is supposed to compile",
+                hint="add an elif arm matching the family tag",
+                qualname="_warm_key",
+            ))
+
+    # -- numeric leg: closed form vs operational simulation (full tree) ------
+    eng_sf = next((sf for sf in files if sf.rel == ENGINE_REL), None)
+    model_sf = next((sf for sf in files if sf.rel == MODEL_REL), None)
+    if eng_sf is None or model_sf is None:
+        return findings
+    anchor = next(
+        (n.lineno for n in ast.walk(model_sf.tree)
+         if isinstance(n, ast.FunctionDef) and n.name == "dispatch_keys"),
+        1,
+    )
+    for label, holes, waste in _check_grid():
+        if holes and not core.allowed(model_sf, RULE, anchor):
+            findings.append(core.make_finding(
+                model_sf, RULE, anchor,
+                f"static retrace proof [{label}]: scheduler arithmetic "
+                f"reaches {len(holes)} key(s) the closed-form lattice "
+                f"misses, e.g. {holes[0]!r} — warmup skips them, so the "
+                f"first live hit compiles on the serving path",
+                hint="extend dispatch_keys() to cover the hole (or fix "
+                     "simulate_keys if the scheduler cannot actually "
+                     "produce it)",
+                qualname="dispatch_keys",
+            ))
+        if waste and not core.allowed(model_sf, RULE_WASTE, anchor):
+            findings.append(core.make_finding(
+                model_sf, RULE_WASTE, anchor,
+                f"warmup waste [{label}]: closed-form lattice declares "
+                f"{len(waste)} key(s) no request can reach, e.g. "
+                f"{waste[0]!r} — each is a wasted warmup compile",
+                hint="tighten dispatch_keys() reachability pruning",
+                qualname="dispatch_keys",
+            ))
+    return findings

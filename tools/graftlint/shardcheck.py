@@ -1,0 +1,237 @@
+"""Sharding-consistency pass over the tensor-parallel layer.
+
+The ROADMAP item-3 TP engine rides on ``parallel/``: one mesh-axis
+vocabulary (``mesh.AXES``), PartitionSpec rules for every tensor, and
+shard_map collectives.  Three failure shapes are cheap to write and
+expensive to debug — a misspelled axis name silently replicates the
+tensor it was meant to split, a host pull on a sharded array gathers
+the full global value through one host, and a ``jax.jit`` without
+sharding annotations lets GSPMD re-decide layouts at the boundary.
+Three rules:
+
+``shard-axis``
+    Every string axis inside a ``P(...)``/``PartitionSpec(...)``
+    literal, an ``axis_name=``/``axis_names=`` kwarg, or a
+    ``lax.p*`` collective's first string argument must be declared in
+    the scanned tree's ``AXES`` tuple (the mesh-axis vocabulary;
+    skipped when no scanned file declares one).
+
+``shard-host-pull``
+    ``.item()`` / ``np.asarray()`` / ``np.array()`` / ``float()`` /
+    ``int()`` on a local holding a shard_map / device_put result —
+    a host gather of device-sharded data on what is usually a hot
+    path.
+
+``shard-jit``
+    ``jax.jit(...)`` without ``in_shardings``/``out_shardings`` in a
+    sharding-centric file (one that touches PartitionSpec or
+    shard_map) — the boundary drops the layout contract the rest of
+    the file spells out.  Engine-style files that never name a
+    PartitionSpec are exempt: their jits are keyed on donation, not
+    layouts.
+
+Waive with ``# graftlint: allow(shard-axis|shard-host-pull|shard-jit)
+why`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.graftlint import core
+
+RULE_AXIS = "shard-axis"
+RULE_PULL = "shard-host-pull"
+RULE_JIT = "shard-jit"
+
+# Collectives that take an axis name (positionally or via axis_name=).
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "pswapaxes",
+    "axis_index", "all_gather", "all_to_all", "psum_scatter", "pcast",
+}
+# Call names whose result lives sharded on device.
+_SHARDED_SOURCES = {"shard_map", "device_put", "shard_tree", "make_array"}
+_HOST_PULLS = {"asarray", "array"}  # np.<name>(tainted)
+
+
+def _declared_axes(files: List[core.SourceFile]) -> Optional[Set[str]]:
+    """Union of module-level AXES tuples in the scan set, or None."""
+    axes: Optional[Set[str]] = None
+    for sf in files:
+        for node in sf.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "AXES"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                names = {
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                }
+                axes = (axes or set()) | names
+    return axes
+
+
+def _spec_axis_names(call: ast.Call):
+    """String axis names used inside a P(...) / PartitionSpec(...)."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                yield node
+
+
+def _call_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _uses_sharding(sf: core.SourceFile) -> bool:
+    """Sharding-centric = the file IMPORTS the sharding vocabulary
+    (PartitionSpec / shard_map). A textual mention in comments — e.g.
+    the engine explaining why it does NOT shard — does not qualify."""
+    for node in sf.tree.body:
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in ("PartitionSpec", "shard_map"):
+                    return True
+    return False
+
+
+def run(files: List[core.SourceFile], ctx: core.Context) -> List[core.Finding]:
+    findings: List[core.Finding] = []
+    axes = _declared_axes(files)
+
+    for sf in files:
+        core.attach_parents(sf.tree)
+        sharding_file = _uses_sharding(sf)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            ln = node.lineno
+            qn = core.qualname_of(node)
+
+            # -- shard-axis: P(...) literals -----------------------------
+            if axes is not None and name in ("P", "PartitionSpec"):
+                for const in _spec_axis_names(node):
+                    if const.value in axes:
+                        continue
+                    if core.allowed(sf, RULE_AXIS, const.lineno, ln):
+                        continue
+                    findings.append(core.make_finding(
+                        sf, RULE_AXIS, const.lineno,
+                        f"PartitionSpec axis \"{const.value}\" is not a "
+                        f"declared mesh axis {tuple(sorted(axes))} — the "
+                        f"dimension silently replicates instead of "
+                        f"sharding",
+                        hint="use an axis from mesh.AXES (or add the new "
+                             "axis there first)",
+                        qualname=qn,
+                    ))
+
+            # -- shard-axis: collectives' axis_name ----------------------
+            if axes is not None and name in _COLLECTIVES:
+                cands = []
+                if node.args:
+                    cands.append(node.args[-1])
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names", "axis"):
+                        cands.append(kw.value)
+                for cand in cands:
+                    for const in ast.walk(cand):
+                        if not (isinstance(const, ast.Constant)
+                                and isinstance(const.value, str)):
+                            continue
+                        if const.value in axes:
+                            continue
+                        if core.allowed(sf, RULE_AXIS, const.lineno, ln):
+                            continue
+                        findings.append(core.make_finding(
+                            sf, RULE_AXIS, const.lineno,
+                            f"collective {name}() names axis "
+                            f"\"{const.value}\" which is not a declared "
+                            f"mesh axis {tuple(sorted(axes))}",
+                            hint="collective axis names must match the "
+                                 "mesh axes the surrounding shard_map "
+                                 "declares manual",
+                            qualname=qn,
+                        ))
+
+            # -- shard-jit ----------------------------------------------
+            if (sharding_file and name == "jit"
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "jax"):
+                kws = {kw.arg for kw in node.keywords}
+                if not kws & {"in_shardings", "out_shardings"}:
+                    if not core.allowed(sf, RULE_JIT, ln):
+                        findings.append(core.make_finding(
+                            sf, RULE_JIT, ln,
+                            "jax.jit in a sharding-centric file carries "
+                            "no in_shardings/out_shardings — the jit "
+                            "boundary drops the layout contract and "
+                            "GSPMD re-decides it",
+                            hint="pass NamedShardings (or move the jit "
+                                 "out of the sharded layer)",
+                            qualname=qn,
+                        ))
+
+        # -- shard-host-pull: function-local taint tracking --------------
+        for fn in (n for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.FunctionDef)):
+            tainted: Set[str] = set()
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    src = node.value.func
+                    # direct: x = device_put(...); curried:
+                    # x = shard_map(...)(...)
+                    names = {_call_name(src)}
+                    if isinstance(src, ast.Call):
+                        names.add(_call_name(src.func))
+                    if names & _SHARDED_SOURCES:
+                        tainted.add(node.targets[0].id)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ln = node.lineno
+                qn = core.qualname_of(node)
+                pulled: Optional[str] = None
+                # x.item()
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in tainted):
+                    pulled = f"{node.func.value.id}.item()"
+                # np.asarray(x) / np.array(x) / float(x) / int(x)
+                elif node.args and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in tainted:
+                    name = _call_name(node.func)
+                    is_np = (isinstance(node.func, ast.Attribute)
+                             and isinstance(node.func.value, ast.Name)
+                             and node.func.value.id in ("np", "numpy")
+                             and name in _HOST_PULLS)
+                    is_builtin = (isinstance(node.func, ast.Name)
+                                  and name in ("float", "int"))
+                    if is_np or is_builtin:
+                        pulled = f"{name}({node.args[0].id})"
+                if pulled is None or core.allowed(sf, RULE_PULL, ln):
+                    continue
+                findings.append(core.make_finding(
+                    sf, RULE_PULL, ln,
+                    f"{pulled} pulls a sharded array to the host — a "
+                    f"cross-host gather of device-sharded data",
+                    hint="keep the reduction device-side (jnp) or fetch "
+                         "an addressable shard explicitly",
+                    qualname=qn,
+                ))
+    return findings
