@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -91,6 +91,15 @@ trace-smoke:
 compile-audit:
 	env JAX_PLATFORMS=cpu python -m tools.compile_audit --static-xcheck
 
+# Scheduler waste observatory gate (docs/benchmarking.md "Reading the
+# waste report"): warmed tiny server + loadtester with SCHED_LEDGER +
+# FLIGHT_RECORDER on — asserts zero attribution on the idle engine, the
+# conservation invariant (useful + pad tokens re-sum to dispatched
+# cells; wait components re-sum to total wait), loadtester/route schema
+# parity, the EngineStats mirror, and the trace_view waste counter lane.
+sched-audit:
+	env JAX_PLATFORMS=cpu python -m tools.sched_audit
+
 bench:
 	python bench.py
 
@@ -102,7 +111,7 @@ bench-compare:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke compile-audit
+ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

@@ -568,6 +568,43 @@ def _compile_counts(engine) -> dict:
     }
 
 
+def _sched_counts(engine, req_s: float = 0.0) -> dict:
+    """Sched-ledger waste report for a phase detail dict (SCHED_LEDGER=1
+    is the bench default): padding_waste_frac, the single goodput_gap
+    scalar (pad + fragmentation share of offered capacity — lower is
+    better, gated by tools/bench_compare.py), its per-cause breakdown,
+    and — when `req_s` is supplied — the roofline headroom report:
+    req/s the two open perf roadmap items would reclaim at this
+    measured waste. Ragged paged attention (ROADMAP item 1) eliminates
+    bucket + group padding, so its ceiling is req_s / (1 - pad_frac);
+    dense-slab deletion (item 2) frees the HBM that forces pool stalls
+    and preemptions, so its number is the stall/preempt churn this run
+    actually paid. Empty when the ledger is off."""
+    snap = engine.debug_sched()
+    if snap is None:
+        return {}
+    gap = snap["goodput_gap"]
+    pad_frac = snap["padding_waste_frac"]
+    out = {
+        "padding_waste_frac": round(pad_frac, 4),
+        "goodput_gap": round(
+            gap["bucket_pad_frac"] + gap["group_pad_frac"]
+            + gap["frag_frac"], 4
+        ),
+        "goodput_gap_breakdown": {k: round(v, 4) for k, v in gap.items()},
+        "sched_conservation_breaches": snap["conservation"]["breaches"],
+    }
+    if req_s > 0.0:
+        out["waste_roofline"] = {
+            "ragged_attention_req_s": round(
+                req_s / (1.0 - pad_frac) if pad_frac < 1.0 else req_s, 2
+            ),  # ROADMAP item 1: padding-free ceiling
+            "slab_deletion_stalls": snap["pool_stall_events"],
+            "slab_deletion_preempted_tokens": snap["preempted_tokens"],
+        }  # ROADMAP item 2: the churn freed HBM would avoid
+    return out
+
+
 def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
                         admit: int = 8):
     """Saturated closed-loop wave -> (req_s, detail dict, sp factory)."""
@@ -625,6 +662,7 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
                 ttfts.append(item["ttft_ms"])
     dt = time.perf_counter() - t0
     comp = _compile_counts(engine)
+    sched = _sched_counts(engine, req_s=n_req / dt)
     engine.stop()
 
     detail = {
@@ -634,6 +672,7 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
         "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 1),
         "device": str(jax.devices()[0]),
         **comp,
+        **sched,
     }
     return n_req / dt, detail, sp
 
@@ -803,10 +842,12 @@ def _measure_chunked(params, cfg) -> dict:
             pass
         snap = engine.stats.snapshot()
         comp = _compile_counts(engine)
+        sched = _sched_counts(engine)
         engine.stop()
         tail = [g for ts, g in gaps if ts >= t_long]
         run.last_snap = snap  # engine-side counters for the report
         run.last_comp = comp
+        run.last_sched = sched
         return 1000.0 * float(np.percentile(tail or [0.0], 99))
 
     base_p99 = run(chunked=False)
@@ -814,6 +855,7 @@ def _measure_chunked(params, cfg) -> dict:
     snap = run.last_snap
     return {
         **run.last_comp,
+        **run.last_sched,
         "streams": CHUNKED_STREAMS,
         "long_prompt_tokens": long_len,
         "prefill_chunk": PROMPT_LEN,
@@ -923,9 +965,11 @@ def _measure_paged(params, cfg) -> dict:
             temperature=0.0, max_new_tokens=new_toks, seed=100 + i)))
     s1 = paged_eng.stats.snapshot()
     comp = _compile_counts(paged_eng)
+    sched = _sched_counts(paged_eng)
     paged_eng.stop()
     return {
         **comp,
+        **sched,
         "kv_block": bs,
         "kv_pool_blocks": pool_blocks + 1,
         "dense_slots": PAGED_DENSE_SLOTS,
@@ -959,6 +1003,7 @@ def main() -> None:
     # (compile_variants / live_retraces) make BENCH_*.json runs
     # auditable for retrace storms via tools/bench_compare.py.
     os.environ.setdefault("COMPILE_LEDGER", "1")
+    os.environ.setdefault("SCHED_LEDGER", "1")
 
     params, cfg = _build(PRESET)
     req_s, detail, sp = _measure_throughput(

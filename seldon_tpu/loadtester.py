@@ -357,6 +357,40 @@ def _compile_counts(url: str) -> dict:
         return {}
 
 
+def _sched_counts(url: str) -> dict:
+    """Best-effort /debug/sched poll after a run: folds the server's
+    waste attribution (padding_waste_frac, budget utilization, the
+    goodput-gap scalar + breakdown) into the ledger. Empty when the
+    server has no sched ledger (SCHED_LEDGER off -> the route 404s)."""
+    import urllib.request
+    try:
+        # Same short-timeout rationale as _compile_counts above.
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/sched", timeout=2
+        ) as resp:
+            sched = json.loads(resp.read())
+        gap = sched["goodput_gap"]
+        return {
+            "padding_waste_frac": float(sched["padding_waste_frac"]),
+            "budget_utilization": float(sched["budget_utilization"]),
+            "goodput_gap": round(
+                float(gap["bucket_pad_frac"]) + float(gap["group_pad_frac"])
+                + float(gap["frag_frac"]), 6
+            ),
+            "goodput_gap_breakdown": {
+                k: float(v) for k, v in gap.items()
+            },
+            "sched_conservation_breaches": int(
+                sched["conservation"]["breaches"]
+            ),
+        }
+    except (OSError, ValueError, KeyError) as exc:
+        logger.debug("loadtester: /debug/sched poll failed (%s: %s) — "
+                     "ledger carries no waste counters",
+                     type(exc).__name__, exc)
+        return {}
+
+
 def report(transport: str, total: int, dt: float, latencies, errors: int,
            clients: int, extra: Optional[dict] = None) -> dict:
     lats = np.asarray(latencies) * 1000.0 if latencies else np.zeros(1)
@@ -447,6 +481,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         if args.decode_len_dist:
             extra["decode_len_dist"] = args.decode_len_dist
         extra.update(_compile_counts(args.url))
+        extra.update(_sched_counts(args.url))
         report("generate", total, dt, lats, errors, args.clients,
                extra=extra)
         return

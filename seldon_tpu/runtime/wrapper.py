@@ -385,28 +385,11 @@ def build_rest_app(
         body, ctype = metrics.export()
         return web.Response(body=body, content_type=ctype.split(";")[0])
 
-    async def handle_timeline(request: web.Request) -> web.Response:
-        """Flight-recorder snapshot (docs/distributed-tracing.md). Duck-
-        typed on the user object so this module never imports the engine;
-        404 when the unit has no recorder or FLIGHT_RECORDER is off."""
-        fn = getattr(user_obj, "debug_timeline", None)
-        if not callable(fn):
-            return web.json_response(
-                {"error": "unit has no flight recorder"}, status=404
-            )
-        loop = asyncio.get_running_loop()
-        snap = await loop.run_in_executor(request.app["executor"], fn)
-        if snap is None:
-            return web.json_response(
-                {"error": "flight recorder disabled "
-                          "(set FLIGHT_RECORDER=1)"}, status=404
-            )
-        return web.json_response(snap)
-
     def _debug_route(attr: str, missing: str, disabled: str):
-        """Factory for duck-typed debug snapshot routes (compile/HBM
-        ledgers follow handle_timeline's shape: 404 with a hint when
-        the unit lacks the hook or the env knob is off)."""
+        """Factory for duck-typed debug snapshot routes (the flight
+        recorder, compile/HBM/sched ledgers): duck-typed on the user
+        object so this module never imports the engine, 404 with a hint
+        when the unit lacks the hook or the env knob is off."""
         async def handler(request: web.Request) -> web.Response:
             fn = getattr(user_obj, attr, None)
             if not callable(fn):
@@ -418,7 +401,10 @@ def build_rest_app(
             return web.json_response(snap)
         return handler
 
-    app.router.add_get("/debug/timeline", handle_timeline)
+    app.router.add_get("/debug/timeline", _debug_route(
+        "debug_timeline", "unit has no flight recorder",
+        "flight recorder disabled (set FLIGHT_RECORDER=1)",
+    ))
     app.router.add_get("/debug/compile", _debug_route(
         "debug_compile", "unit has no compile ledger",
         "compile ledger disabled (set COMPILE_LEDGER=1)",
@@ -426,6 +412,10 @@ def build_rest_app(
     app.router.add_get("/debug/hbm", _debug_route(
         "debug_hbm", "unit has no hbm ledger",
         "hbm ledger disabled (set HBM_LEDGER=1)",
+    ))
+    app.router.add_get("/debug/sched", _debug_route(
+        "debug_sched", "unit has no sched ledger",
+        "sched ledger disabled (set SCHED_LEDGER=1)",
     ))
 
     app.router.add_get("/live", handle_live)

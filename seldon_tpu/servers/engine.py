@@ -44,7 +44,7 @@ from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
 from seldon_tpu.servers import compile_ledger, flight_recorder, graftsan
-from seldon_tpu.servers import hbm_ledger, shape_lattice
+from seldon_tpu.servers import hbm_ledger, sched_ledger, shape_lattice
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -383,6 +383,31 @@ class EngineStats:
         self.dispatch_edges_ms = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
                                   100.0, 200.0, 500.0)
         self.variant_ms = {}  # graftlint: guarded-by(lock) via(stats)
+        # Scheduler-waste observability (SCHED_LEDGER=1; all stay zero
+        # — and no record_waste_locked calls — otherwise). Token counts
+        # mirror the sched ledger's conservation-audited totals; the
+        # histogram buckets each dispatched boundary's padding fraction
+        # on the same fixed-edge idiom as ITL.
+        self.sched_boundaries = 0  # graftlint: guarded-by(lock) via(stats)
+        self.sched_idle_boundaries = 0  # graftlint: guarded-by(lock) via(stats)
+        self.sched_useful_tokens = 0  # graftlint: guarded-by(lock) via(stats)
+        self.sched_bucket_pad_tokens = 0  # graftlint: guarded-by(lock) via(stats)
+        self.sched_group_pad_tokens = 0  # graftlint: guarded-by(lock) via(stats)
+        self.sched_frag_tokens = 0  # graftlint: guarded-by(lock) via(stats)
+        self.waste_edges_frac = (0.01, 0.02, 0.05, 0.10, 0.20, 0.35,
+                                 0.50, 0.75)
+        self.waste_counts = [0] * (len(self.waste_edges_frac) + 1)  # graftlint: guarded-by(lock) via(stats)
+
+    def record_waste_locked(self, frac: float) -> None:  # graftlint: holds(lock)
+        """Caller holds self.lock. One dispatched boundary's padding
+        fraction (pad cells / offered cells) from the sched ledger."""
+        i = 0
+        for edge in self.waste_edges_frac:
+            if frac <= edge:
+                break
+            i += 1
+        self.waste_counts[i] += 1
+        self.sched_boundaries += 1
 
     def record_variant_locked(self, key: str, ms: float) -> None:  # graftlint: holds(lock)
         """Caller holds self.lock. One boundary duration for `key`."""
@@ -520,6 +545,25 @@ class EngineStats:
                     if (self.deadline_met_total + self.deadline_missed_total)
                     else 1.0
                 ),
+                "sched_boundaries": self.sched_boundaries,
+                "sched_idle_boundaries": self.sched_idle_boundaries,
+                "sched_useful_tokens": self.sched_useful_tokens,
+                "sched_bucket_pad_tokens": self.sched_bucket_pad_tokens,
+                "sched_group_pad_tokens": self.sched_group_pad_tokens,
+                "sched_frag_tokens": self.sched_frag_tokens,
+                "padding_waste_frac": (
+                    (self.sched_bucket_pad_tokens
+                     + self.sched_group_pad_tokens)
+                    / (self.sched_useful_tokens
+                       + self.sched_bucket_pad_tokens
+                       + self.sched_group_pad_tokens)
+                    if (self.sched_useful_tokens
+                        + self.sched_bucket_pad_tokens
+                        + self.sched_group_pad_tokens)
+                    else 0.0
+                ),
+                "waste_edges_frac": list(self.waste_edges_frac),
+                "waste_counts": list(self.waste_counts),
                 "dispatch_edges_ms": list(self.dispatch_edges_ms),
                 "variant_timing": {
                     k: {"count": h["count"], "sum_ms": h["sum_ms"],
@@ -830,6 +874,11 @@ class InferenceEngine:
             self._hbm.gauge("kv_cache", self._hbm_kv_reserved_bytes)
             self._hbm.gauge("kv_live", self._hbm_kv_live_bytes)
             self._hbm.gauge("prefix_cache", self._hbm_prefix_bytes)
+        # Scheduler waste observatory (SCHED_LEDGER=1; None — and zero
+        # hot-path code — otherwise): per-boundary goodput attribution,
+        # queue-wait decomposition, and the conservation audit that
+        # runs next to graftsan's boundary audits.
+        self._sled = sched_ledger.from_env()
         # Runtime concurrency sanitizer (GRAFTSAN=1; None — and zero
         # hot-path code — otherwise). Wraps every lock above in an
         # order-asserting proxy, so this must stay the LAST piece of
@@ -1513,6 +1562,15 @@ class InferenceEngine:
             return None
         return self._hbm.snapshot()
 
+    def debug_sched(self) -> Optional[Dict[str, Any]]:
+        """Sched-ledger snapshot (per-boundary waste attribution,
+        goodput-gap decomposition, queue-wait components, conservation
+        audit), or None when SCHED_LEDGER is off — the /debug/sched
+        payload."""
+        if self._sled is None:
+            return None
+        return self._sled.snapshot()
+
     def _hbm_kv_reserved_bytes(self) -> int:
         """Static KV reservation: the full cache tree (dense slot slab
         or paged block pool). nbytes is shape metadata — no sync."""
@@ -2027,6 +2085,10 @@ class InferenceEngine:
                 req.first_dispatch_at = now
                 wait += now - req.submitted_at
                 n += 1
+                if self._sled is not None:
+                    self._sled.note_first_dispatch(
+                        req.rid, req.submitted_at, now
+                    )
                 if self._recorder is not None:
                     self._recorder.record(
                         "admit", req.rid,
@@ -2045,6 +2107,7 @@ class InferenceEngine:
         groups. Dispatches device work only — returns un-synced handles."""
         self._drain_pending()
         admits: List[Tuple[List[_Request], Any, Any, Any]] = []
+        last_key: Optional[Tuple[int, int]] = None
         while self._free and self._waiting:
             key = self._admit_key(self._waiting[0])
             max_g = min(self._max_admit, len(self._free))
@@ -2074,9 +2137,12 @@ class InferenceEngine:
                         "pool-stall", self._waiting[0].rid,
                         {"waiting": len(self._waiting)},
                     )
+                if self._sled is not None:
+                    self._sled.note_pool_stall(self._waiting[0].rid)
                 break
             try:
                 admits.append(self._dispatch_admit_group(group, *key))
+                last_key = key
             except Exception as e:  # bad batch must not kill the loop
                 logger.exception(
                     "admission failed for requests %s",
@@ -2088,6 +2154,16 @@ class InferenceEngine:
                             and slot not in self._free:
                         self._free.append(slot)  # popped but never registered
                     self._fail_req(req, str(e), kind="internal")
+        # Bucket-mismatch wait attribution: the engine filled up and the
+        # head-of-line request buckets differently from the last group
+        # admitted — it waits behind the lattice shape, not raw capacity.
+        if (self._sled is not None and last_key is not None
+                and self._waiting and not self._free):
+            head = self._waiting[0]
+            if self._bucket(
+                len(head.tokens) - (head.prefix_len or 0)
+            ) != last_key[0]:
+                self._sled.note_bucket_defer(head.rid)
         return admits
 
     def _dispatch_admit_group(  # graftlint: holds(_book)
@@ -2108,6 +2184,26 @@ class InferenceEngine:
         Gp = 1
         while Gp < G:
             Gp *= 2
+        if self._sled is not None:
+            # Waste attribution for this group's static shape: every one
+            # of the Gp*Sb offered token-slots is useful suffix, bucket
+            # rounding, or pow2 group replication — exactly (the
+            # conservation audit holds this to the cell).
+            useful = sum(
+                len(r.tokens) - (r.prefix_len if Pb else 0) for r in group
+            )
+            bpad = G * Sb - useful
+            gpad = (Gp - G) * Sb
+            fam = (
+                ("admit-paged", Sb, Gp, Pb) if self._paged
+                else ("admit-prefix", Pb, Sb, Gp) if Pb
+                else ("admit", Sb, Gp)
+            )
+            self._sled.note_group(fam, Gp * Sb, useful, bpad, gpad)
+            with self.stats.lock:
+                self.stats.sched_useful_tokens += useful
+                self.stats.sched_bucket_pad_tokens += bpad
+                self.stats.sched_group_pad_tokens += gpad
         self._record_first_dispatch(group)
         for req in group:
             req.slot = self._free.pop()
@@ -2342,6 +2438,11 @@ class InferenceEngine:
                 return None
             with self.stats.lock:
                 self.stats.preemptions += 1
+            if self._sled is not None:
+                # Churn = prefill + decode work the victim throws away.
+                self._sled.note_preempt(
+                    victim.rid, len(victim.tokens) + victim.n_generated
+                )
             if self._recorder is not None:
                 self._recorder.record(
                     "preempt", victim.rid,
@@ -2562,6 +2663,8 @@ class InferenceEngine:
                             "pool-stall", req.rid,
                             {"waiting": len(self._waiting)},
                         )
+                    if self._sled is not None:
+                        self._sled.note_pool_stall(req.rid)
                     break
                 self._waiting.popleft()
                 self._admit_chunk_slot(req)
@@ -2595,6 +2698,19 @@ class InferenceEngine:
         Gp = 1
         while Gp < G:
             Gp *= 2
+        if self._sled is not None:
+            # Same exact cell split as _dispatch_admit_group: useful
+            # chunk tokens + bucket rounding + pow2 row replication.
+            useful = sum(r[4] for r in rows)
+            bpad = G * Sc - useful
+            gpad = (Gp - G) * Sc
+            self._sled.note_group(
+                ("chunk", Sc, Gp, W), Gp * Sc, useful, bpad, gpad
+            )
+            with self.stats.lock:
+                self.stats.sched_useful_tokens += useful
+                self.stats.sched_bucket_pad_tokens += bpad
+                self.stats.sched_group_pad_tokens += gpad
         toks = np.full((Gp, Sc), self.cfg.pad_token_id, np.int32)
         plens = np.empty((Gp,), np.int32)
         starts = np.empty((Gp,), np.int32)
@@ -2788,6 +2904,18 @@ class InferenceEngine:
                 self.stats.budget_dispatches += 1
                 self.stats.budget_tokens += budget - left
                 self.stats.budget_limit = budget
+            if self._sled is not None:
+                # Starved = the pass ended with prefill work still
+                # queued; only then does unspent budget count as
+                # fragmentation (an idle-queue surplus is light load,
+                # not waste) or mark budget contention for waits.
+                starved = bool(
+                    self._prefilling or (self._waiting and self._free)
+                )
+                self._sled.note_budget(budget, budget - left, starved)
+                if starved and left > 0:
+                    with self.stats.lock:
+                        self.stats.sched_frag_tokens += left
         return admits
 
     # --- boundary processing -----------------------------------------------
@@ -3080,6 +3208,8 @@ class InferenceEngine:
         self._record_wave_timing(timing)
         if self._san is not None:
             self._san.audit(self)
+        if self._sled is not None:
+            self._sled.audit()
 
     def _record_wave_timing(self, timing) -> None:  # graftlint: holds(_book)
         """Per-variant boundary timing: the wave's dispatch keys against
@@ -3216,6 +3346,8 @@ class InferenceEngine:
                     self._record_wave_timing(timing)
                     if self._san is not None:
                         self._san.audit(self)
+                    if self._sled is not None:
+                        self._sled.audit()
             except Exception as e:
                 logger.exception("boundary fetch failed")
                 self._drain_and_fail(str(e), current=item)
@@ -3429,13 +3561,23 @@ class InferenceEngine:
                 d.copy_to_host_async()
             for h in (toks, valid, active_after):
                 h.copy_to_host_async()
+            wf = 0.0
+            if self._sled is not None:
+                self._sled.note_boundary()
+                wf = self._sled.boundary_waste()
+                with self.stats.lock:
+                    self.stats.record_waste_locked(wf)
             if self._recorder is not None:
-                self._recorder.record(
-                    "boundary", -1,
-                    {"admits": sum(len(g) for g, _, _, _ in admits),
-                     "chunk": n,
-                     "active": int(self._active_host.sum())},
-                )
+                detail = {
+                    "admits": sum(len(g) for g, _, _, _ in admits),
+                    "chunk": n,
+                    "active": int(self._active_host.sum()),
+                }
+                if self._paged:
+                    detail["pool_free"] = int(self._allocator.free_count)
+                if self._sled is not None:
+                    detail["waste_frac"] = round(wf, 4)
+                self._recorder.record("boundary", -1, detail)
             if self._timing_on:
                 timing = (time.perf_counter(), self._wave_keys)
                 self._wave_keys = []
@@ -3468,6 +3610,10 @@ class InferenceEngine:
                 # Blocks OUTSIDE the lock, so the fetcher keeps draining.
                 self._fetch_q.put(work)
             elif self._pending.empty():
+                if self._sled is not None:
+                    self._sled.note_idle()
+                    with self.stats.lock:
+                        self.stats.sched_idle_boundaries += 1
                 time.sleep(self.ecfg.idle_sleep_s)
 
     def _loop_sync(self) -> None:
@@ -3498,15 +3644,27 @@ class InferenceEngine:
                             self.stats.decode_dispatches += 1
                             self.stats.decode_steps += n
                         self._recycle_budget_spent(roster, n)
+                        wf = 0.0
+                        if self._sled is not None:
+                            self._sled.note_boundary()
+                            wf = self._sled.boundary_waste()
+                            with self.stats.lock:
+                                self.stats.record_waste_locked(wf)
                         if self._recorder is not None:
-                            self._recorder.record(
-                                "boundary", -1,
-                                {"admits": sum(
+                            detail = {
+                                "admits": sum(
                                     len(g) for g, _, _, _ in admits
-                                 ),
-                                 "chunk": n,
-                                 "active": int(self._active_host.sum())},
-                            )
+                                ),
+                                "chunk": n,
+                                "active": int(self._active_host.sum()),
+                            }
+                            if self._paged:
+                                detail["pool_free"] = int(
+                                    self._allocator.free_count
+                                )
+                            if self._sled is not None:
+                                detail["waste_frac"] = round(wf, 4)
+                            self._recorder.record("boundary", -1, detail)
                     else:
                         chunk_handles = None
                     if self._timing_on and (
@@ -3531,6 +3689,10 @@ class InferenceEngine:
                 # Sleep outside the lock so drain()/cancel() never wait
                 # on an idle tick.
                 if idle and self._pending.empty():
+                    if self._sled is not None:
+                        self._sled.note_idle()
+                        with self.stats.lock:
+                            self.stats.sched_idle_boundaries += 1
                     time.sleep(self.ecfg.idle_sleep_s)
             except Exception as e:  # fail requests, reset, keep serving
                 logger.exception("engine iteration failed")

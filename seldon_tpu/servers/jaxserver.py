@@ -551,10 +551,17 @@ class JAXServer(SeldonComponent):
             return None
         return self.engine.debug_hbm()
 
+    def debug_sched(self) -> Optional[Dict]:
+        """Engine sched-ledger snapshot for the /debug/sched endpoint
+        (None when SCHED_LEDGER is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_sched()
+
     def _observatory_metrics(self, s: Dict) -> List[Dict]:
-        """Compile-ledger and per-variant dispatch gauges. Empty when
-        the observatory is off — the Prometheus surface only grows for
-        operators who turned the knobs on."""
+        """Compile/HBM/sched-ledger and per-variant dispatch gauges.
+        Empty when the observatory is off — the Prometheus surface only
+        grows for operators who turned the knobs on."""
         out: List[Dict] = []
         comp = self.engine.debug_compile()
         if comp is not None:
@@ -584,6 +591,34 @@ class JAXServer(SeldonComponent):
                     "type": "GAUGE", "key": "jaxserver_hbm_bytes",
                     "value": float(cat["bytes"]),
                     "tags": {"category": name},
+                })
+        sched = self.engine.debug_sched()
+        if sched is not None:
+            out.extend([
+                {"type": "GAUGE", "key": "jaxserver_padding_waste_frac",
+                 "value": float(sched["padding_waste_frac"])},
+                {"type": "GAUGE",
+                 "key": "jaxserver_sched_budget_utilization",
+                 "value": float(sched["budget_utilization"])},
+                {"type": "GAUGE", "key": "jaxserver_sched_idle_boundaries",
+                 "value": float(sched["idle_boundaries"])},
+                {"type": "GAUGE", "key": "jaxserver_preempted_tokens",
+                 "value": float(sched["preempted_tokens"])},
+                {"type": "GAUGE",
+                 "key": "jaxserver_sched_conservation_breaches",
+                 "value": float(sched["conservation"]["breaches"])},
+            ])
+            for cause, frac in sorted(sched["goodput_gap"].items()):
+                out.append({
+                    "type": "GAUGE", "key": "jaxserver_goodput_gap",
+                    "value": float(frac),
+                    "tags": {"cause": cause},
+                })
+            for comp in ("pool_ms", "bucket_ms", "budget_ms", "sched_ms"):
+                out.append({
+                    "type": "GAUGE", "key": "jaxserver_queue_wait_ms_total",
+                    "value": float(sched["wait"][comp]),
+                    "tags": {"component": comp},
                 })
         return out
 

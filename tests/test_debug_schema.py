@@ -1,20 +1,22 @@
 """Frozen-schema golden tests for the debug observatory snapshots.
 
-``/debug/compile`` and ``/debug/hbm`` are consumed by at least four
+``/debug/compile``, ``/debug/hbm`` and ``/debug/sched`` are consumed by
 parties that never import this repo's dataclasses: the loadtester's
-ledger poll, ``tools/compile_audit.py``, ``tools/probe_hbm``, and
-whatever dashboards operators curl together.  Their schemas are frozen
-here as literal key sets.  If one of these tests fails, you changed the
-wire contract: update the module docstrings in
-``seldon_tpu/servers/compile_ledger.py`` / ``hbm_ledger.py``, the
-consumers above, AND these goldens in the same PR — never just the
-golden.
+ledger polls, ``tools/compile_audit.py`` / ``tools/sched_audit.py``,
+``tools/probe_hbm``, and whatever dashboards operators curl together.
+Their schemas are frozen here as literal key sets.  If one of these
+tests fails, you changed the wire contract: update the module
+docstrings in ``seldon_tpu/servers/compile_ledger.py`` /
+``hbm_ledger.py`` / ``sched_ledger.py``, the consumers above, AND
+these goldens in the same PR — never just the golden.
 """
 
 import json
+import time
 
 from seldon_tpu.servers.compile_ledger import CompileLedger
 from seldon_tpu.servers.hbm_ledger import HbmLedger
+from seldon_tpu.servers.sched_ledger import SchedLedger
 
 # The documented /debug/compile schema, frozen.
 COMPILE_TOP_KEYS = frozenset({
@@ -35,6 +37,44 @@ COMPILE_LATTICE_KEYS = frozenset({
 # The documented /debug/hbm schema, frozen.
 HBM_TOP_KEYS = frozenset({"categories", "total_bytes", "total_high_bytes"})
 HBM_CATEGORY_KEYS = frozenset({"bytes", "high_bytes", "static"})
+
+# The documented /debug/sched schema, frozen (tools/sched_audit.py
+# carries the same top-level golden).
+SCHED_TOP_KEYS = frozenset({
+    "boundaries",
+    "dispatch_boundaries",
+    "idle_boundaries",
+    "dispatch_cells",
+    "useful_tokens",
+    "bucket_pad_tokens",
+    "group_pad_tokens",
+    "frag_tokens",
+    "budget_offered_tokens",
+    "budget_used_tokens",
+    "budget_starved_passes",
+    "padding_waste_frac",
+    "budget_utilization",
+    "goodput_gap",
+    "pool_stall_events",
+    "pool_stall_requests",
+    "preemptions",
+    "preempted_tokens",
+    "wait",
+    "conservation",
+    "by_shape",
+})
+SCHED_GAP_KEYS = frozenset({
+    "bucket_pad_frac", "group_pad_frac", "frag_frac", "idle_frac",
+})
+SCHED_WAIT_KEYS = frozenset({
+    "requests", "total_ms", "pool_ms", "bucket_ms", "budget_ms",
+    "sched_ms",
+})
+SCHED_CONSERVATION_KEYS = frozenset({"checked", "breaches", "last_breach"})
+SCHED_SHAPE_KEYS = frozenset({
+    "key", "dispatches", "cells", "useful_tokens", "bucket_pad_tokens",
+    "group_pad_tokens",
+})
 
 
 def _populated_compile_ledger() -> CompileLedger:
@@ -57,6 +97,25 @@ def _populated_hbm_ledger() -> HbmLedger:
     led.set_static("kv_cache", 1 << 18)
     led.gauge("kv_live", lambda: 4096)
     led.note_workspace(2048)
+    return led
+
+
+def _populated_sched_ledger() -> SchedLedger:
+    """A ledger exercising every snapshot branch: admission + chunk
+    groups, a starved budget pass, stalls/preempts, idle and dispatch
+    boundaries, a decomposed queue wait, and a clean audit pass."""
+    led = SchedLedger()
+    led.note_group(("admit", 64, 4), 256, 100, 92, 64)
+    led.note_group(("chunk", 128, 2, 0), 256, 200, 56, 0)
+    led.note_budget(512, 400, starved=True)
+    led.note_pool_stall(7)
+    led.note_bucket_defer(7)
+    led.note_preempt(9, tokens=48)
+    led.note_boundary()
+    led.note_idle()
+    now = time.perf_counter()
+    led.note_first_dispatch(7, submitted_at=now - 0.05, now=now)
+    led.audit()
     return led
 
 
@@ -112,10 +171,59 @@ def test_hbm_snapshot_value_kinds():
     assert snap["total_bytes"] == sum(c["bytes"] for c in cats.values())
 
 
+def test_sched_snapshot_key_set_is_frozen():
+    snap = _populated_sched_ledger().snapshot()
+    assert set(snap) == SCHED_TOP_KEYS
+    assert set(snap["goodput_gap"]) == SCHED_GAP_KEYS
+    assert set(snap["wait"]) == SCHED_WAIT_KEYS
+    assert set(snap["conservation"]) == SCHED_CONSERVATION_KEYS
+    assert snap["by_shape"], "fixture must produce shape entries"
+    for entry in snap["by_shape"]:
+        assert set(entry) == SCHED_SHAPE_KEYS
+
+
+def test_sched_snapshot_value_kinds():
+    snap = _populated_sched_ledger().snapshot()
+    assert isinstance(snap["boundaries"], int)
+    assert snap["boundaries"] == (snap["dispatch_boundaries"]
+                                  + snap["idle_boundaries"])
+    assert isinstance(snap["padding_waste_frac"], float)
+    assert isinstance(snap["budget_utilization"], float)
+    for frac in snap["goodput_gap"].values():
+        assert isinstance(frac, float) and 0.0 <= frac <= 1.0
+    for comp in snap["wait"].values():
+        assert isinstance(comp, (int, float)) and comp >= 0
+    # The fixture's audit() pass must have run clean.
+    assert snap["conservation"]["checked"] == 1
+    assert snap["conservation"]["breaches"] == 0
+    assert snap["conservation"]["last_breach"] is None
+    # Conservation restated from the snapshot itself.
+    assert (snap["useful_tokens"] + snap["bucket_pad_tokens"]
+            + snap["group_pad_tokens"]) == snap["dispatch_cells"]
+    for entry in snap["by_shape"]:
+        # Keys render as the canonical slash-joined string, not tuples.
+        assert isinstance(entry["key"], str) and "/" in entry["key"]
+
+
+def test_sched_snapshot_empty_ledger_same_keys():
+    # A never-touched ledger serves the SAME key set (consumers need no
+    # existence checks), just with empty/zero values.
+    snap = SchedLedger().snapshot()
+    assert set(snap) == SCHED_TOP_KEYS
+    assert set(snap["goodput_gap"]) == SCHED_GAP_KEYS
+    assert set(snap["wait"]) == SCHED_WAIT_KEYS
+    assert snap["by_shape"] == []
+    assert snap["dispatch_cells"] == 0
+    assert snap["padding_waste_frac"] == 0.0
+    assert snap["budget_utilization"] == 1.0
+
+
 def test_snapshots_are_json_clean():
-    # Both snapshots must survive json.dumps untouched — they go over
+    # All snapshots must survive json.dumps untouched — they go over
     # the wire verbatim from the debug routes.
     comp = json.loads(json.dumps(_populated_compile_ledger().snapshot()))
     assert set(comp) == COMPILE_TOP_KEYS
     hbm = json.loads(json.dumps(_populated_hbm_ledger().snapshot()))
     assert set(hbm) == HBM_TOP_KEYS
+    sched = json.loads(json.dumps(_populated_sched_ledger().snapshot()))
+    assert set(sched) == SCHED_TOP_KEYS

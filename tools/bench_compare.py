@@ -12,7 +12,10 @@ Every numeric scalar in the metric line is flattened to a dot path
 and compared base -> candidate with a direction heuristic:
 
  * lower-is-better:  names containing ``ms``, ``latency``, ``stall``,
-   ``frag``, ``dropped``, ``error``;
+   ``frag``, ``dropped``, ``error``, plus the exact waste metrics
+   ``padding_waste_frac`` / ``goodput_gap`` (the sched ledger's
+   lost-capacity fractions — checked before the ``goodput`` substring
+   would claim them as higher-is-better);
  * higher-is-better: names containing ``req_per_s``, ``req_s``,
    ``tokens_per_s``, ``speedup``, ``hit_rate``, ``goodput``,
    ``coverage``, plus the headline ``value`` / ``vs_baseline``;
@@ -46,6 +49,10 @@ _HIGHER = ("req_per_s", "req_s", "tokens_per_s", "speedup", "hit_rate",
            "goodput", "coverage")
 # Exact leaf-name matches for the headline numbers.
 _HIGHER_EXACT = ("value", "vs_baseline")
+# Exact lower-is-better leaves, checked BEFORE the substring tables:
+# "goodput_gap" would otherwise match the higher-is-better "goodput"
+# substring, and "padding_waste_frac" matches nothing ("frac" != "frag").
+_LOWER_EXACT = ("padding_waste_frac", "goodput_gap")
 _STRICT = ("live_retraces",)
 
 
@@ -98,6 +105,8 @@ def direction(path: str) -> str:
     leaf = path.rsplit(".", 1)[-1]
     if leaf in _STRICT:
         return "strict"
+    if leaf in _LOWER_EXACT:
+        return "lower"
     if leaf in _HIGHER_EXACT:
         return "higher"
     if any(s in leaf for s in _HIGHER):

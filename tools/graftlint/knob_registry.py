@@ -131,6 +131,14 @@ KNOBS = {
                      "live KV / prefix cache / workspace live-byte "
                      "accounting with high-watermarks, served at "
                      "/debug/hbm and folded into probe_hbm."),
+    "SCHED_LEDGER": _k("runtime", "0",
+                       "Enable the scheduler waste ledger: per-boundary "
+                       "goodput attribution (bucket/group padding, chunk "
+                       "fragmentation, idle boundaries, preemption "
+                       "churn), queue-wait decomposition, and a "
+                       "conservation audit run under the bookkeeping "
+                       "lock. Served at /debug/sched; gated by `make "
+                       "sched-audit`."),
     "DISPATCH_TIMING": _k("runtime", "0",
                           "Per-variant dispatch duration histograms, "
                           "measured at the scheduler's deliberate sync "

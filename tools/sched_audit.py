@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""CI sched audit: the scheduler waste observatory end to end.
+
+Boots the tiny warmed JAXServer behind the real REST app with
+``SCHED_LEDGER=1`` + ``FLIGHT_RECORDER=1``, polls ``/debug/sched`` on
+the idle engine, drives it with a short closed-loop loadtester run,
+then asserts the observatory contract in one pass:
+
+ * idle engine -> ZERO attribution: no dispatch cells, no useful or
+   pad tokens, no wait decomposition — only idle boundaries tick;
+ * after load, ``/debug/sched`` returns the documented schema and the
+   conservation invariant holds: useful + bucket-pad + group-pad
+   tokens re-sum to the dispatched cells within 1% (the ledger's own
+   ``audit()`` — run under ``_book`` at every boundary — must report
+   zero breaches, and this script recomputes the sum independently);
+ * the queue-wait components (pool / bucket / budget / sched) re-sum
+   to the total measured wait within 1%;
+ * the loadtester ledger carries the same ``padding_waste_frac`` /
+   ``goodput_gap`` numbers as the route (schema parity — the token
+   counters are static once the load window closes);
+ * EngineStats mirrors the ledger (``sched_boundaries`` matches the
+   waste histogram mass, ``padding_waste_frac`` agrees), and the
+   jaxserver Prometheus surface exports the gauges;
+ * boundary records carry ``waste_frac`` and ``tools/trace_view.py``
+   renders the ``padding_waste_frac`` counter lane from them.
+
+Run via ``make sched-audit`` (wired into ``make ci``); exits non-zero
+with a one-line diagnosis on the first failed check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+# Frozen /debug/sched top-level key set — tests/test_debug_schema.py
+# carries the same golden; a mismatch here means the snapshot schema
+# changed without updating its consumers.
+SCHED_TOP_KEYS = frozenset({
+    "boundaries", "dispatch_boundaries", "idle_boundaries",
+    "dispatch_cells", "useful_tokens", "bucket_pad_tokens",
+    "group_pad_tokens", "frag_tokens", "budget_offered_tokens",
+    "budget_used_tokens", "budget_starved_passes", "padding_waste_frac",
+    "budget_utilization", "goodput_gap", "pool_stall_events",
+    "pool_stall_requests", "preemptions", "preempted_tokens", "wait",
+    "conservation", "by_shape",
+})
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        print(f"sched-audit FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["SCHED_LEDGER"] = "1"
+    os.environ["FLIGHT_RECORDER"] = "1"
+
+    import asyncio
+    import threading
+    import urllib.request
+
+    from aiohttp import web
+
+    from seldon_tpu.loadtester import main as lt_main
+    from seldon_tpu.runtime.wrapper import build_rest_app
+    from seldon_tpu.servers.jaxserver import JAXServer
+    from tools import trace_view
+
+    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=64, warmup=1)
+    srv.load()
+
+    holder, started = {}, threading.Event()
+
+    async def amain() -> None:
+        runner = web.AppRunner(build_rest_app(srv))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    _check(started.wait(60), "REST app failed to start within 60s")
+    url = f"http://127.0.0.1:{holder['port']}"
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(url + path, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # --- idle engine: zero attribution ------------------------------
+        idle = get("/debug/sched")
+        _check(set(idle) == SCHED_TOP_KEYS,
+               f"/debug/sched keys drifted: got {sorted(idle)}")
+        for key in ("dispatch_cells", "useful_tokens", "bucket_pad_tokens",
+                    "group_pad_tokens", "frag_tokens", "pool_stall_events",
+                    "preemptions"):
+            _check(idle[key] == 0, f"idle engine has {key}={idle[key]}")
+        _check(idle["wait"]["requests"] == 0,
+               f"idle engine attributed {idle['wait']['requests']} waits")
+        _check(idle["padding_waste_frac"] == 0.0,
+               "idle engine reports nonzero padding waste")
+
+        # --- load window ------------------------------------------------
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            lt_main([
+                url, "--transport", "generate", "--clients", "4",
+                "--seconds", "2", "--prompt", "hi",
+                "--max-new-tokens", "4",
+            ])
+        ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
+        detail = ledger["detail"]
+        _check(detail["errors"] == 0,
+               f"loadtester saw {detail['errors']} transport errors")
+        _check(detail["requests"] >= 1, "loadtester completed no requests")
+
+        sched = get("/debug/sched")
+        snap = get("/debug/timeline")
+    finally:
+        holder["stop"] = True
+        t.join(timeout=10)
+
+    # --- schema + conservation -----------------------------------------
+    _check(set(sched) == SCHED_TOP_KEYS,
+           f"/debug/sched keys drifted: got {sorted(sched)}")
+    cons = sched["conservation"]
+    _check(cons["checked"] > 0, "conservation audit never ran")
+    _check(
+        cons["breaches"] == 0,
+        f"{cons['breaches']} conservation breaches: {cons['last_breach']}",
+    )
+    cells = sched["dispatch_cells"]
+    attributed = (sched["useful_tokens"] + sched["bucket_pad_tokens"]
+                  + sched["group_pad_tokens"])
+    _check(cells > 0, "no cells dispatched under load")
+    _check(
+        abs(attributed - cells) <= max(1, cells // 100),
+        f"attributed tokens {attributed} != dispatched cells {cells}",
+    )
+    _check(sched["useful_tokens"] > 0, "no useful tokens attributed")
+    _check(sched["dispatch_boundaries"] > 0, "no dispatch boundaries")
+    _check(
+        sched["boundaries"]
+        == sched["dispatch_boundaries"] + sched["idle_boundaries"],
+        "boundary counts do not re-sum",
+    )
+    _check(0.0 <= sched["padding_waste_frac"] <= 1.0,
+           f"padding_waste_frac out of range: {sched['padding_waste_frac']}")
+    by_shape_cells = sum(e["cells"] for e in sched["by_shape"])
+    _check(by_shape_cells == cells,
+           f"by_shape cells {by_shape_cells} != total {cells}")
+
+    wait = sched["wait"]
+    _check(wait["requests"] >= 1, "no queue waits attributed")
+    parts = (wait["pool_ms"] + wait["bucket_ms"] + wait["budget_ms"]
+             + wait["sched_ms"])
+    _check(
+        abs(parts - wait["total_ms"]) <= max(1.0, 0.01 * wait["total_ms"]),
+        f"wait components {parts} != total {wait['total_ms']}",
+    )
+
+    # --- loadtester ledger parity (counters static post-run) ------------
+    _check(
+        detail.get("padding_waste_frac") == sched["padding_waste_frac"],
+        f"ledger padding_waste_frac {detail.get('padding_waste_frac')} != "
+        f"/debug/sched {sched['padding_waste_frac']}",
+    )
+    gap = sched["goodput_gap"]
+    route_gap = round(gap["bucket_pad_frac"] + gap["group_pad_frac"]
+                      + gap["frag_frac"], 6)
+    _check(
+        detail.get("goodput_gap") == route_gap,
+        f"ledger goodput_gap {detail.get('goodput_gap')} != "
+        f"/debug/sched {route_gap}",
+    )
+    _check(detail.get("sched_conservation_breaches") == 0,
+           f"ledger breaches = {detail.get('sched_conservation_breaches')}")
+
+    # --- EngineStats mirror + Prometheus surface ------------------------
+    stats = srv.engine.stats.snapshot()
+    # The stats snapshot is taken after the route poll; allow the slack
+    # of the fetch-queue depth for any trailing drain boundaries.
+    _check(abs(stats["sched_boundaries"]
+               - sched["dispatch_boundaries"]) <= 4,
+           f"stats sched_boundaries {stats['sched_boundaries']} != ledger "
+           f"{sched['dispatch_boundaries']}")
+    _check(sum(stats["waste_counts"]) == stats["sched_boundaries"],
+           "waste histogram mass != sched_boundaries")
+    _check(
+        abs(stats["padding_waste_frac"] - sched["padding_waste_frac"])
+        < 1e-4,
+        f"stats padding_waste_frac {stats['padding_waste_frac']} != "
+        f"ledger {sched['padding_waste_frac']}",
+    )
+    gauges = {m["key"] for m in srv.metrics()}
+    for key in ("jaxserver_padding_waste_frac", "jaxserver_goodput_gap",
+                "jaxserver_queue_wait_ms_total",
+                "jaxserver_sched_conservation_breaches"):
+        _check(key in gauges, f"metrics() missing gauge {key}")
+
+    # --- flight recorder + trace_view counter lane ----------------------
+    boundaries = [r for r in snap.get("records", [])
+                  if r["kind"] == "boundary"]
+    _check(boundaries, "no boundary records in timeline")
+    _check(any("waste_frac" in (r.get("detail") or {})
+               for r in boundaries),
+           "boundary records carry no waste_frac")
+    out = json.loads(json.dumps(trace_view.convert(snap)))
+    counters = {e["name"] for e in out["traceEvents"] if e["ph"] == "C"}
+    _check("padding_waste_frac" in counters,
+           f"trace_view rendered no waste counter lane (got {counters})")
+
+    srv.engine.stop()
+
+    print(json.dumps({
+        "metric": "sched_audit",
+        "value": 1,
+        "detail": {
+            "requests": detail["requests"],
+            "dispatch_cells": cells,
+            "useful_tokens": sched["useful_tokens"],
+            "padding_waste_frac": sched["padding_waste_frac"],
+            "goodput_gap": route_gap,
+            "idle_boundaries": sched["idle_boundaries"],
+            "conservation_checked": cons["checked"],
+            "wait_requests": wait["requests"],
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

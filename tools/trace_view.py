@@ -18,8 +18,12 @@ Rendering model:
  * point records (trie-hit/miss, cow, preempt, pool-stall, chaos,
    drain, fail-all, profile markers) become "i" instants on the
    owning request's track (engine-wide ones on the scheduler track);
- * "boundary" records also emit a "C" counter series (`active_slots`)
-   so scheduler occupancy reads as a graph above the slices;
+ * "boundary" records also emit "C" counter series — `active_slots`
+   always, `pool_blocks_free` when the engine is paged (the allocator's
+   free count rides every boundary record), and `padding_waste_frac`
+   when SCHED_LEDGER=1 (the sched ledger's per-wave pad fraction) — so
+   scheduler occupancy, pool headroom and shape waste read as graphs
+   above the slices;
  * "dispatch" records (DISPATCH_TIMING=1) become "X" slices on a
    second "variants" process — one lane per compile-ledger variant key
    ("admit/32/4", "decode/8", ...), spanning dispatch -> boundary so
@@ -152,6 +156,16 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                 "ph": "C", "pid": 1, "name": "active_slots", "ts": ts,
                 "args": {"active": detail.get("active", 0)},
             })
+            if "pool_free" in detail:
+                events.append({
+                    "ph": "C", "pid": 1, "name": "pool_blocks_free",
+                    "ts": ts, "args": {"free": detail["pool_free"]},
+                })
+            if "waste_frac" in detail:
+                events.append({
+                    "ph": "C", "pid": 1, "name": "padding_waste_frac",
+                    "ts": ts, "args": {"frac": detail["waste_frac"]},
+                })
         else:
             events.append({
                 "ph": "i", "pid": 1, "tid": track(rid), "name": kind,
