@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -100,6 +100,16 @@ compile-audit:
 sched-audit:
 	env JAX_PLATFORMS=cpu python -m tools.sched_audit
 
+# Pilot controller gate (docs/operations.md "Flying with the
+# autopilot"): warmed tiny chunked server + mixed-deadline loadtester
+# under PILOT=1 + GRAFTSAN=1 — asserts the controller converges to a
+# ledgered decision, every knob stays inside its clamp envelope, the
+# conservation audit and sanitizer stay clean under the pilot, route /
+# loadtester parity, the jaxserver_pilot_* gauges, and the trace_view
+# decision lane.
+pilot-audit:
+	env JAX_PLATFORMS=cpu python -m tools.pilot_audit
+
 bench:
 	python bench.py
 
@@ -111,7 +121,7 @@ bench-compare:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit
+ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

@@ -90,6 +90,12 @@ CHUNKED_LONG_X = int(os.environ.get("BENCH_CHUNKED_LONG_X", "8"))
 # admits until the POOL (not the slot count) runs out. Also records
 # zero-copy warm admissions off the block trie. Recorded in detail.paged.
 PAGED = os.environ.get("BENCH_PAGED", "0") == "1"
+# Pilot phase: one mixed-deadline closed wave run twice at equal
+# hardware — PILOT=1 (graftpilot auto-tuning + EDF) vs pilot off — so
+# the bench line carries the controller's goodput delta, decision count
+# and final knob values (tools/bench_compare.py gates slo_goodput
+# higher-is-better and pilot_edf_inversions lower-is-better).
+PILOT_PHASE = os.environ.get("BENCH_PILOT", "0") == "1"
 PAGED_DENSE_SLOTS = int(os.environ.get("BENCH_PAGED_DENSE_SLOTS", "4"))
 PAGED_KV_BLOCK = int(os.environ.get("BENCH_PAGED_KV_BLOCK", "16"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
@@ -521,6 +527,94 @@ def _measure_slo(params, cfg, sp, slots: int = 0) -> dict:
             1,
         ),
     }
+
+
+def _measure_pilot(params, cfg, sp) -> dict:
+    """BENCH_PILOT phase: the same mixed-deadline closed wave through
+    the same chunked-prefill engine config, once with PILOT=1 and once
+    with the pilot off. The wave interleaves loose-deadline, tight-
+    deadline and no-deadline requests (tight AFTER loose within each
+    triple, so FIFO order carries real EDF inversions), and the tight
+    TTL is calibrated off an unloaded probe request so the wave is
+    achievable-but-pressured on any rig. Reports per-leg slo_goodput /
+    deadline split, and for the pilot leg the decision count, final
+    knob values and EDF counters from /debug/pilot's snapshot."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    slots = min(SLOTS, 32)
+    nreq = 3 * slots
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(3, cfg.vocab_size, size=(PROMPT_LEN,)).tolist()
+
+    def leg(pilot: bool) -> dict:
+        prev = os.environ.get("PILOT")
+        os.environ["PILOT"] = "1" if pilot else "0"
+        try:
+            engine = InferenceEngine(params, cfg, EngineConfig(
+                max_slots=slots,
+                max_seq_len=PROMPT_LEN + NEW_TOKENS + 1,
+                prompt_buckets=(PROMPT_LEN,),
+                max_admit=8,
+                decode_chunk=DECODE_CHUNK,
+                chunked_prefill=True,
+                prefill_chunk=64,
+            ))
+        finally:
+            if prev is None:
+                os.environ.pop("PILOT", None)
+            else:
+                os.environ["PILOT"] = prev
+        engine.warmup()
+        engine.start()
+        # Unloaded probe: calibrates the tight TTL to the rig instead
+        # of hard-coding a wall time a tunneled TPU could never hold.
+        t0 = time.perf_counter()
+        q = engine.submit(prompt, sp(500))
+        while q.get(timeout=300) is not None:
+            pass
+        t_one_ms = 1000.0 * (time.perf_counter() - t0)
+        ddl_ms = max(2000, int(4.0 * t_one_ms * nreq / slots))
+        queues = []
+        for i in range(nreq):
+            if i % 3 == 0:
+                p = sp(3000 + i)  # no deadline: the EDF aging path
+            elif i % 3 == 1:
+                p = _dc.replace(sp(3000 + i), deadline_ms=4 * ddl_ms)
+            else:  # tight submitted after loose: an EDF inversion
+                p = _dc.replace(sp(3000 + i), deadline_ms=ddl_ms)
+            queues.append(engine.submit(prompt, p))
+        for q in queues:
+            try:
+                while q.get(timeout=300) is not None:
+                    pass
+            except Exception:
+                pass  # expired requests end via the error item
+        engine.drain(timeout=120)
+        st = engine.stats.snapshot()
+        psnap = engine.debug_pilot()
+        engine.stop()
+        out = {
+            "slo_goodput": round(st["goodput"], 4),
+            "deadline_met": st["deadline_met_total"],
+            "deadline_missed": st["deadline_missed_total"],
+            "deadline_expired": st["deadline_expired_total"],
+            # Calibration constant, not a metric — named without "ms"
+            # so bench_compare's latency substring gate skips it.
+            "tight_deadline": ddl_ms,
+        }
+        if psnap is not None:
+            out["pilot_decisions"] = psnap["decisions_total"]
+            out["pilot_decisions_by_knob"] = psnap["decisions_by_knob"]
+            out["final_knobs"] = psnap["knobs"]
+            out["pilot_edf_inversions"] = psnap["edf"]["inversions"]
+            out["pilot_expired_at_pop"] = psnap["edf"]["expired_at_pop"]
+        return out
+
+    return {"on": leg(True), "off": leg(False)}
 
 
 def _build(preset: str):
@@ -1058,6 +1152,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"paged phase failed: {e!r}")
             detail["paged_error"] = str(e)
+
+    if PILOT_PHASE:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["pilot"] = _measure_pilot(params, cfg, sp)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"pilot phase failed: {e!r}")
+            detail["pilot_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

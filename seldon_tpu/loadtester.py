@@ -20,6 +20,7 @@ import argparse
 import asyncio
 import json
 import logging
+import sys
 import time
 from typing import List, Optional
 
@@ -162,6 +163,7 @@ async def run_generate(url: str, clients: int, seconds: float,
                        decode_len_dist: str = "",
                        cancel_frac: float = 0.0,
                        deadline_ms: int = 0,
+                       deadline_frac: float = 1.0,
                        trace_sample: float = 0.0):
     """LLM serving load: closed-loop generation clients. Latency is full
     completion time; tokens/s is the serving-throughput number. Greedy
@@ -192,7 +194,10 @@ async def run_generate(url: str, clients: int, seconds: float,
     max_tokens); deadline_ms > 0 stamps a per-request TTL on every
     request. Every request lands in exactly one `outcomes` bucket
     ({completed, shed, draining, deadline, cancelled, error}); `errors`
-    stays the legacy everything-not-completed total.
+    stays the legacy everything-not-completed total. deadline_frac < 1
+    stamps the TTL on only that fraction of requests — the
+    MIXED-deadline wave an EDF scheduler (PILOT=1) reorders, leaving
+    the rest to the no-deadline aging path.
 
     trace_sample > 0 stamps that fraction of requests with a freshly
     generated W3C traceparent (riding meta.tags like deadline_ms — the
@@ -203,6 +208,7 @@ async def run_generate(url: str, clients: int, seconds: float,
     len_rng = np.random.default_rng(1)
     cancel_rng = np.random.default_rng(2)
     trace_rng = np.random.default_rng(3)
+    deadline_rng = np.random.default_rng(4)
     sampled_traces: List[str] = []
     tokens = [0]
     ttfts: List[float] = []
@@ -275,7 +281,9 @@ async def run_generate(url: str, clients: int, seconds: float,
             "temperature": temperature,
         }
         tags = {}
-        if deadline_ms > 0:
+        if deadline_ms > 0 and (
+            deadline_frac >= 1.0 or deadline_rng.random() < deadline_frac
+        ):
             # The REST edge parses this into a proto GenerateRequest,
             # which has no deadline field — the TTL rides meta.tags
             # (see seldon_methods._generate_request_dict).
@@ -391,6 +399,37 @@ def _sched_counts(url: str) -> dict:
         return {}
 
 
+def _pilot_counts(url: str) -> dict:
+    """Best-effort /debug/pilot poll after a run: folds the controller's
+    final decision count, knob values and EDF counters into the ledger —
+    the "what did the autopilot actually do" line for a load run. Empty
+    when the server flies no pilot (PILOT off -> the route 404s)."""
+    import urllib.request
+    try:
+        # Same short-timeout rationale as _compile_counts above.
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/pilot", timeout=2
+        ) as resp:
+            pilot = json.loads(resp.read())
+        return {
+            "pilot_decisions": int(pilot["decisions_total"]),
+            "pilot_decisions_by_knob": {
+                k: int(v) for k, v in pilot["decisions_by_knob"].items()
+            },
+            "pilot_knobs": dict(pilot["knobs"]),
+            "pilot_edf_inversions": int(pilot["edf"]["inversions"]),
+            "pilot_expired_at_pop": int(pilot["edf"]["expired_at_pop"]),
+            "pilot_goodput_delta": float(
+                pilot["counterfactual"]["goodput_delta"]
+            ),
+        }
+    except (OSError, ValueError, KeyError) as exc:
+        logger.debug("loadtester: /debug/pilot poll failed (%s: %s) — "
+                     "ledger carries no pilot counters",
+                     type(exc).__name__, exc)
+        return {}
+
+
 def report(transport: str, total: int, dt: float, latencies, errors: int,
            clients: int, extra: Optional[dict] = None) -> dict:
     lats = np.asarray(latencies) * 1000.0 if latencies else np.zeros(1)
@@ -453,6 +492,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="--transport generate: per-request TTL in "
                              "ms stamped on every request (deadline "
                              "injection); 0 disables")
+    parser.add_argument("--deadline-frac", type=float, default=1.0,
+                        help="--transport generate: fraction of requests "
+                             "the --deadline-ms TTL is stamped on (mixed-"
+                             "deadline wave for the EDF scheduler); 1.0 "
+                             "stamps every request")
     parser.add_argument("--trace-sample", type=float, default=0.0,
                         help="--transport generate: fraction of requests "
                              "stamped with a generated W3C traceparent "
@@ -470,6 +514,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                          decode_len_dist=args.decode_len_dist,
                          cancel_frac=args.cancel_frac,
                          deadline_ms=args.deadline_ms,
+                         deadline_frac=args.deadline_frac,
                          trace_sample=args.trace_sample)
         )
         extra = {"completion_tokens": toks,
@@ -482,8 +527,19 @@ def main(argv: Optional[List[str]] = None) -> None:
             extra["decode_len_dist"] = args.decode_len_dist
         extra.update(_compile_counts(args.url))
         extra.update(_sched_counts(args.url))
+        pilot = _pilot_counts(args.url)
+        extra.update(pilot)
         report("generate", total, dt, lats, errors, args.clients,
                extra=extra)
+        if pilot:
+            # Human-readable autopilot postscript (the JSON ledger line
+            # above stays machine-parseable and last-but-one).
+            print(
+                f"pilot: {pilot['pilot_decisions']} decisions, "
+                f"final knobs {pilot['pilot_knobs']}, "
+                f"{pilot['pilot_edf_inversions']} EDF inversions",
+                file=sys.stderr,
+            )
         return
     if args.transport == "rest":
         total, dt, lats, errors = asyncio.run(

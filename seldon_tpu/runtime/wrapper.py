@@ -417,6 +417,10 @@ def build_rest_app(
         "debug_sched", "unit has no sched ledger",
         "sched ledger disabled (set SCHED_LEDGER=1)",
     ))
+    app.router.add_get("/debug/pilot", _debug_route(
+        "debug_pilot", "unit has no pilot controller",
+        "pilot disabled (set PILOT=1)",
+    ))
 
     app.router.add_get("/live", handle_live)
     app.router.add_get("/health/live", handle_live)

@@ -43,8 +43,8 @@ from seldon_tpu.core import tracing
 from seldon_tpu.models import transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
-from seldon_tpu.servers import compile_ledger, flight_recorder, graftsan
-from seldon_tpu.servers import hbm_ledger, sched_ledger, shape_lattice
+from seldon_tpu.servers import compile_ledger, controller, flight_recorder
+from seldon_tpu.servers import graftsan, hbm_ledger, sched_ledger, shape_lattice
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -879,6 +879,23 @@ class InferenceEngine:
         # queue-wait decomposition, and the conservation audit that
         # runs next to graftsan's boundary audits.
         self._sled = sched_ledger.from_env()
+        # graftpilot (PILOT=1 auto / PILOT=hold pinned; None — and the
+        # raw FIFO dispatch path — otherwise): bounded feedback
+        # controller over dispatch_token_budget / admission group size /
+        # chunk rung plus EDF deadline ordering, with the decision
+        # ledger served at /debug/pilot. The sched ledger is its signal
+        # source, so PILOT implies one even without SCHED_LEDGER=1.
+        self._pilot = controller.from_env()
+        if self._pilot is not None:
+            if self._sled is None:
+                self._sled = sched_ledger.SchedLedger()
+            self._pilot.bind(
+                chunked=self._chunked,
+                prefill_chunk=self._prefill_chunk if self._chunked else 0,
+                max_slots=self.ecfg.max_slots,
+                max_admit=self._max_admit,
+                dispatch_token_budget=self.ecfg.dispatch_token_budget,
+            )
         # Runtime concurrency sanitizer (GRAFTSAN=1; None — and zero
         # hot-path code — otherwise). Wraps every lock above in an
         # order-asserting proxy, so this must stay the LAST piece of
@@ -1571,6 +1588,18 @@ class InferenceEngine:
             return None
         return self._sled.snapshot()
 
+    def debug_pilot(self) -> Optional[Dict[str, Any]]:
+        """Pilot-controller snapshot (live knobs, envelope, EDF
+        counters, decision ledger with counterfactual effects), or None
+        when PILOT is off — the /debug/pilot payload. Unlike the other
+        ledgers the controller's state is guarded-by(_book) (it IS
+        scheduler state), so the snapshot takes the lock: cold path,
+        bounded ledger, legal from the HTTP thread."""
+        if self._pilot is None:
+            return None
+        with self._book:
+            return self._pilot.snapshot()
+
     def _hbm_kv_reserved_bytes(self) -> int:
         """Static KV reservation: the full cache tree (dense slot slab
         or paged block pool). nbytes is shape metadata — no sync."""
@@ -2071,8 +2100,91 @@ class InferenceEngine:
                 self._waiting.append(self._pending.get_nowait())
             except queue.Empty:
                 break
+        if self._pilot is not None:
+            # EDF ordering (stable; no-deadline requests age via a
+            # virtual deadline). An already-ordered queue — including
+            # every all-FIFO workload — comes back as the same object.
+            self._waiting = self._pilot.order_queue(self._waiting)
         with self.stats.lock:
             self.stats.queue_depth = len(self._waiting)
+
+    def _admit_cap(self) -> int:  # graftlint: holds(_book)
+        """Admission group-size cap: the pilot's live (power-of-two,
+        clamped) value when flying, the static config cap otherwise."""
+        if self._pilot is not None:
+            return self._pilot.admit_cap()
+        return self._max_admit
+
+    def _shed_expired_head(self) -> bool:  # graftlint: holds(_book)
+        """EDF pop-time margin re-check (pilot callers only): if the
+        head of the admission queue already missed its deadline, fail
+        it here — before it claims a slot, pool blocks, or budget a
+        viable request could use — and return True so the caller
+        re-examines the new head. The boundary-cadence reap still
+        sheds mid-queue expiries; this closes the pop-time race where
+        a request expires between the reap and its own admission."""
+        req = self._waiting[0]
+        now = time.perf_counter()
+        if req.deadline is None or now < req.deadline:
+            return False
+        self._waiting.popleft()
+        with self.stats.lock:
+            self.stats.deadline_expired_total += 1
+            self.stats.shed_total += 1
+        self._fail_req(
+            req,
+            f"deadline exceeded after "
+            f"{1000.0 * (now - req.submitted_at):.0f} ms in queue",
+            kind="deadline",
+        )
+        self._pilot.note_expired_pop()
+        return True
+
+    def _pilot_signals(self) -> Dict[str, float]:  # graftlint: holds(_book)
+        """Cumulative signal sample for the pilot's decision windows:
+        sched-ledger counters (PILOT implies the ledger, so _sled is
+        never None here), the stats SLO mirror, and instantaneous
+        queue/slot levels. Keys are the controller's frozen
+        signal_snapshot schema (controller.py docstring)."""
+        sled = self._sled.snapshot()
+        with self.stats.lock:
+            budget_dispatches = self.stats.budget_dispatches
+            expired = self.stats.deadline_expired_total
+            met = self.stats.deadline_met_total
+            missed = self.stats.deadline_missed_total
+        finished = met + missed
+        return {
+            "boundaries": sled["dispatch_boundaries"],
+            "dispatch_cells": sled["dispatch_cells"],
+            "useful_tokens": sled["useful_tokens"],
+            "frag_tokens": sled["frag_tokens"],
+            "budget_dispatches": budget_dispatches,
+            "budget_starved_passes": sled["budget_starved_passes"],
+            "budget_offered_tokens": sled["budget_offered_tokens"],
+            "budget_used_tokens": sled["budget_used_tokens"],
+            "pool_stall_events": sled["pool_stall_events"],
+            "preemptions": sled["preemptions"],
+            "deadline_expired": expired,
+            "goodput": met / finished if finished else 1.0,
+            "queue_depth": len(self._waiting),
+            "free_slots": len(self._free),
+        }
+
+    def _pilot_tick(self) -> None:  # graftlint: holds(_book)
+        """One pilot boundary: advance the control loop and mirror any
+        new decisions into the flight recorder (the Perfetto decision
+        lane in tools/trace_view.py)."""
+        decisions = self._pilot.on_boundary(self._pilot_signals)
+        if self._recorder is not None:
+            for d in decisions:
+                self._recorder.record(
+                    "pilot", -1,
+                    {"knob": d["knob"], "old": d["old"], "new": d["new"],
+                     "rationale": d["rationale"],
+                     "budget": self._pilot.dispatch_budget(),
+                     "max_admit": self._pilot.admit_cap(),
+                     "chunk_bias": self._pilot.chunk_bias()},
+                )
 
     def _record_first_dispatch(self, group: List[_Request]) -> None:
         """Queue-wait accounting: submit -> first dispatch, once per
@@ -2109,15 +2221,21 @@ class InferenceEngine:
         admits: List[Tuple[List[_Request], Any, Any, Any]] = []
         last_key: Optional[Tuple[int, int]] = None
         while self._free and self._waiting:
+            if self._pilot is not None and self._shed_expired_head():
+                continue  # expired head must not displace a viable one
             key = self._admit_key(self._waiting[0])
-            max_g = min(self._max_admit, len(self._free))
+            max_g = min(self._admit_cap(), len(self._free))
             group: List[_Request] = []
             reserved = 0
+            shed = False
             while (
                 len(group) < max_g
                 and self._waiting
                 and self._admit_key(self._waiting[0]) == key
             ):
+                if self._pilot is not None and self._shed_expired_head():
+                    shed = True
+                    continue  # loop condition re-keys on the new head
                 if self._paged:
                     # Pool gate BEFORE the pop: the whole group's owned
                     # blocks must fit (after trie eviction), so dispatch-
@@ -2130,6 +2248,10 @@ class InferenceEngine:
                     reserved += need
                 group.append(self._waiting.popleft())
             if not group:
+                if shed:
+                    continue  # head expired mid-fill, not a pool stall
+                if not self._waiting:
+                    break
                 with self.stats.lock:
                     self.stats.pool_stalls += 1
                 if self._recorder is not None:
@@ -2645,6 +2767,8 @@ class InferenceEngine:
                 if req.finished:  # failed by an earlier error path
                     continue
             elif self._waiting and self._free:
+                if self._pilot is not None and self._shed_expired_head():
+                    continue  # expired head must not claim a slot
                 req = self._waiting[0]
                 rem = len(req.tokens)
                 est = C if rem > C else self._chunk_bucket(rem)
@@ -2865,7 +2989,10 @@ class InferenceEngine:
         full budget."""
         self._drain_pending()
         admits: List[Tuple[List[_Request], Any, Any, Any]] = []
-        budget = self.ecfg.dispatch_token_budget or self._prefill_chunk
+        if self._pilot is not None:
+            budget = self._pilot.dispatch_budget()
+        else:
+            budget = self.ecfg.dispatch_token_budget or self._prefill_chunk
         left = budget
         n_chunks = 0
         n_tokens = 0
@@ -2878,7 +3005,7 @@ class InferenceEngine:
                 j = i + 1
                 while (
                     j < len(work)
-                    and j - i < self._max_admit
+                    and j - i < self._admit_cap()
                     and work[j][1:3] == work[i][1:3]
                 ):
                     j += 1
@@ -3269,14 +3396,21 @@ class InferenceEngine:
         # max_admit ~ max_slots) don't read "half empty" as saturated.
         sat = min(self._max_admit, (n_slots + 7) // 8)
         if free < sat:
-            return sizes[-1]  # saturated: nothing admittable mid-chunk
-        if free < n_slots // 4:
+            idx = len(sizes) - 1  # saturated: nothing admittable mid-chunk
+        elif free < n_slots // 4:
             # Mid rung, capped below the top: with only two rungs
             # (e.g. decode_chunk=8, min_chunk=4 dedups to (4, 8)),
             # len//2 would resolve to the TOP rung and near-saturation
             # would silently lose its admission boundaries.
-            return sizes[min(len(sizes) // 2, len(sizes) - 2)]
-        return sizes[0]
+            idx = min(len(sizes) // 2, len(sizes) - 2)
+        else:
+            idx = 0
+        if self._pilot is not None:
+            # Deadline-pressure bias moves the occupancy pick at most
+            # one rung (pilot never leaves the compiled ladder).
+            idx = max(0, min(idx + self._pilot.chunk_bias(),
+                             len(sizes) - 1))
+        return sizes[idx]
 
     def _recycle_budget_spent(self, roster: List[Optional[_Request]],  # graftlint: holds(_book)
                               chunk_len: int) -> None:
@@ -3567,6 +3701,8 @@ class InferenceEngine:
                 wf = self._sled.boundary_waste()
                 with self.stats.lock:
                     self.stats.record_waste_locked(wf)
+            if self._pilot is not None:
+                self._pilot_tick()
             if self._recorder is not None:
                 detail = {
                     "admits": sum(len(g) for g, _, _, _ in admits),
@@ -3650,6 +3786,8 @@ class InferenceEngine:
                             wf = self._sled.boundary_waste()
                             with self.stats.lock:
                                 self.stats.record_waste_locked(wf)
+                        if self._pilot is not None:
+                            self._pilot_tick()
                         if self._recorder is not None:
                             detail = {
                                 "admits": sum(

@@ -558,6 +558,13 @@ class JAXServer(SeldonComponent):
             return None
         return self.engine.debug_sched()
 
+    def debug_pilot(self) -> Optional[Dict]:
+        """Engine pilot-controller snapshot for the /debug/pilot
+        endpoint (None when PILOT is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_pilot()
+
     def _observatory_metrics(self, s: Dict) -> List[Dict]:
         """Compile/HBM/sched-ledger and per-variant dispatch gauges.
         Empty when the observatory is off — the Prometheus surface only
@@ -620,6 +627,26 @@ class JAXServer(SeldonComponent):
                     "value": float(sched["wait"][comp]),
                     "tags": {"component": comp},
                 })
+        pilot = self.engine.debug_pilot()
+        if pilot is not None:
+            for knob, n in sorted(pilot["decisions_by_knob"].items()):
+                out.append({
+                    "type": "GAUGE",
+                    "key": "jaxserver_pilot_decisions_total",
+                    "value": float(n),
+                    "tags": {"knob": knob},
+                })
+            out.extend([
+                {"type": "GAUGE", "key": "jaxserver_pilot_budget_current",
+                 "value": float(pilot["knobs"]["dispatch_token_budget"])},
+                {"type": "GAUGE", "key": "jaxserver_pilot_admit_current",
+                 "value": float(pilot["knobs"]["max_admit"])},
+                {"type": "GAUGE", "key": "jaxserver_pilot_edf_inversions",
+                 "value": float(pilot["edf"]["inversions"])},
+                {"type": "GAUGE", "key": "jaxserver_pilot_goodput_delta",
+                 "value": float(
+                     pilot["counterfactual"]["goodput_delta"])},
+            ])
         return out
 
     def _slo_metrics(self, s: Dict) -> List[Dict]:

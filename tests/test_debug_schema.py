@@ -1,20 +1,23 @@
 """Frozen-schema golden tests for the debug observatory snapshots.
 
-``/debug/compile``, ``/debug/hbm`` and ``/debug/sched`` are consumed by
-parties that never import this repo's dataclasses: the loadtester's
-ledger polls, ``tools/compile_audit.py`` / ``tools/sched_audit.py``,
+``/debug/compile``, ``/debug/hbm``, ``/debug/sched`` and
+``/debug/pilot`` are consumed by parties that never import this repo's
+dataclasses: the loadtester's ledger polls, ``tools/compile_audit.py``
+/ ``tools/sched_audit.py`` / ``tools/pilot_audit.py``,
 ``tools/probe_hbm``, and whatever dashboards operators curl together.
 Their schemas are frozen here as literal key sets.  If one of these
 tests fails, you changed the wire contract: update the module
 docstrings in ``seldon_tpu/servers/compile_ledger.py`` /
-``hbm_ledger.py`` / ``sched_ledger.py``, the consumers above, AND
-these goldens in the same PR — never just the golden.
+``hbm_ledger.py`` / ``sched_ledger.py`` / ``controller.py``, the
+consumers above, AND these goldens in the same PR — never just the
+golden.
 """
 
 import json
 import time
 
 from seldon_tpu.servers.compile_ledger import CompileLedger
+from seldon_tpu.servers.controller import PilotController
 from seldon_tpu.servers.hbm_ledger import HbmLedger
 from seldon_tpu.servers.sched_ledger import SchedLedger
 
@@ -76,6 +79,44 @@ SCHED_SHAPE_KEYS = frozenset({
     "group_pad_tokens",
 })
 
+# The documented /debug/pilot schema, frozen (tools/pilot_audit.py
+# carries the same top-level + ledger-entry goldens).
+PILOT_TOP_KEYS = frozenset({
+    "enabled",
+    "mode",
+    "boundaries",
+    "windows",
+    "period_boundaries",
+    "decisions_total",
+    "decisions_by_knob",
+    "knobs",
+    "envelope",
+    "edf",
+    "counterfactual",
+    "ledger",
+})
+PILOT_KNOB_KEYS = frozenset({
+    "dispatch_token_budget", "max_admit", "chunk_bias",
+})
+PILOT_ENVELOPE_KEYS = frozenset({
+    "budget_min", "budget_max", "admit_min", "admit_max", "bias_min",
+    "bias_max",
+})
+PILOT_EDF_KEYS = frozenset({"inversions", "reorders", "expired_at_pop"})
+PILOT_CF_KEYS = frozenset({"windows", "goodput_delta", "waste_frac_delta"})
+PILOT_LEDGER_KEYS = frozenset({
+    "ts", "knob", "old", "new", "rationale", "expected_effect",
+    "signal_snapshot", "effect",
+})
+PILOT_EFFECT_KEYS = frozenset({"goodput_delta", "waste_frac_delta"})
+PILOT_SIGNAL_KEYS = frozenset({
+    "boundaries", "dispatch_cells", "useful_tokens", "frag_tokens",
+    "budget_dispatches", "budget_starved_passes",
+    "budget_offered_tokens", "budget_used_tokens", "pool_stall_events",
+    "preemptions", "deadline_expired", "goodput", "queue_depth",
+    "free_slots",
+})
+
 
 def _populated_compile_ledger() -> CompileLedger:
     """A ledger exercising every snapshot branch: declared + dispatched
@@ -117,6 +158,44 @@ def _populated_sched_ledger() -> SchedLedger:
     led.note_first_dispatch(7, submitted_at=now - 0.05, now=now)
     led.audit()
     return led
+
+
+def _populated_pilot() -> PilotController:
+    """A controller exercising every snapshot branch: a bound envelope,
+    an EDF reorder + expired pop, one budget decision with its effect
+    window already measured (counterfactual filled)."""
+    import collections as _c
+    import types as _t
+
+    pilot = PilotController()
+    pilot.bind(chunked=True, prefill_chunk=8, max_slots=4, max_admit=4,
+               dispatch_token_budget=8)
+    now = time.perf_counter()
+    pilot.order_queue(_c.deque([
+        _t.SimpleNamespace(deadline=now + 9.0, submitted_at=now),
+        _t.SimpleNamespace(deadline=now + 1.0, submitted_at=now),
+    ]))
+    pilot.note_expired_pop()
+
+    def _windows(sig):
+        for _ in range(pilot.period):
+            pilot.on_boundary(lambda: dict(sig))
+
+    base = {
+        "boundaries": 0, "dispatch_cells": 0, "useful_tokens": 0,
+        "frag_tokens": 0, "budget_dispatches": 0,
+        "budget_starved_passes": 0, "budget_offered_tokens": 0,
+        "budget_used_tokens": 0, "pool_stall_events": 0,
+        "preemptions": 0, "deadline_expired": 0, "goodput": 1.0,
+        "queue_depth": 0, "free_slots": 4,
+    }
+    _windows(base)  # window 1 only baselines
+    starved = dict(base, budget_dispatches=4, budget_starved_passes=4,
+                   budget_offered_tokens=32, budget_used_tokens=32,
+                   queue_depth=6)
+    _windows(starved)  # window 2: budget raise decision
+    _windows(dict(starved, goodput=0.75))  # window 3: effect measured
+    return pilot
 
 
 def test_compile_snapshot_key_set_is_frozen():
@@ -218,6 +297,68 @@ def test_sched_snapshot_empty_ledger_same_keys():
     assert snap["budget_utilization"] == 1.0
 
 
+def test_pilot_snapshot_key_set_is_frozen():
+    snap = _populated_pilot().snapshot()
+    assert set(snap) == PILOT_TOP_KEYS
+    assert set(snap["decisions_by_knob"]) == PILOT_KNOB_KEYS
+    assert set(snap["knobs"]) == PILOT_KNOB_KEYS
+    assert set(snap["envelope"]) == PILOT_ENVELOPE_KEYS
+    assert set(snap["edf"]) == PILOT_EDF_KEYS
+    assert set(snap["counterfactual"]) == PILOT_CF_KEYS
+    assert snap["ledger"], "fixture must produce a decision"
+    for entry in snap["ledger"]:
+        assert set(entry) == PILOT_LEDGER_KEYS
+        assert set(entry["signal_snapshot"]) == PILOT_SIGNAL_KEYS
+        # The fixture closed the effect window: the counterfactual half
+        # of every entry is filled, with exactly the documented keys.
+        assert set(entry["effect"]) == PILOT_EFFECT_KEYS
+
+
+def test_pilot_snapshot_value_kinds():
+    snap = _populated_pilot().snapshot()
+    assert snap["enabled"] is True
+    assert snap["mode"] == "auto"
+    assert isinstance(snap["boundaries"], int)
+    assert isinstance(snap["windows"], int)
+    assert isinstance(snap["period_boundaries"], int)
+    assert snap["decisions_total"] == sum(
+        snap["decisions_by_knob"].values())
+    for v in snap["knobs"].values():
+        assert isinstance(v, int)
+    for v in snap["envelope"].values():
+        assert isinstance(v, int)
+    for v in snap["edf"].values():
+        assert isinstance(v, int)
+    assert isinstance(snap["counterfactual"]["goodput_delta"], float)
+    for entry in snap["ledger"]:
+        assert isinstance(entry["ts"], float)
+        assert isinstance(entry["old"], int)
+        assert isinstance(entry["new"], int)
+        assert entry["rationale"] and isinstance(entry["rationale"], str)
+        assert entry["expected_effect"]
+        for v in entry["signal_snapshot"].values():
+            assert isinstance(v, (int, float))
+    # Live knobs stay inside the envelope — restated from the snapshot.
+    env, knobs = snap["envelope"], snap["knobs"]
+    assert env["budget_min"] <= knobs["dispatch_token_budget"] \
+        <= env["budget_max"]
+    assert env["admit_min"] <= knobs["max_admit"] <= env["admit_max"]
+    assert env["bias_min"] <= knobs["chunk_bias"] <= env["bias_max"]
+
+
+def test_pilot_snapshot_empty_controller_same_keys():
+    # A never-flown controller serves the SAME key set (consumers need
+    # no existence checks), just with empty/zero values.
+    pilot = PilotController()
+    pilot.bind(chunked=True, prefill_chunk=8, max_slots=4, max_admit=4,
+               dispatch_token_budget=8)
+    snap = pilot.snapshot()
+    assert set(snap) == PILOT_TOP_KEYS
+    assert snap["boundaries"] == 0
+    assert snap["decisions_total"] == 0
+    assert snap["ledger"] == []
+
+
 def test_snapshots_are_json_clean():
     # All snapshots must survive json.dumps untouched — they go over
     # the wire verbatim from the debug routes.
@@ -227,3 +368,5 @@ def test_snapshots_are_json_clean():
     assert set(hbm) == HBM_TOP_KEYS
     sched = json.loads(json.dumps(_populated_sched_ledger().snapshot()))
     assert set(sched) == SCHED_TOP_KEYS
+    pilot = json.loads(json.dumps(_populated_pilot().snapshot()))
+    assert set(pilot) == PILOT_TOP_KEYS

@@ -228,6 +228,36 @@ def test_deadline_finalizes_mid_decode():
         eng.stop()
 
 
+def test_pilot_sheds_expired_head_at_pop(monkeypatch):
+    """EDF pop-time margin re-check (the pilot's expiry-at-pop fix): a
+    head request that expired between the boundary reap and its own
+    admission is failed at pop time — before it claims a slot or
+    displaces the viable request queued behind it."""
+    monkeypatch.setenv("PILOT", "1")
+    eng = _engine(start=False)  # scheduler idle: we drive the pop by hand
+    q_dead = eng.submit([3, 4], SamplingParams(
+        temperature=0.0, max_new_tokens=4, deadline_ms=1))
+    q_live = eng.submit([5, 6], GREEDY)
+    time.sleep(0.01)  # let the 1 ms TTL lapse while both sit queued
+    try:
+        with eng._book:
+            admits = eng._dispatch_admits()
+        toks, err = _collect(q_dead, timeout=10)
+        assert toks == 0
+        assert err["kind"] == "deadline"
+        # The viable request behind the expired head was admitted in the
+        # same pass — shedding re-examined the new head, it didn't bail.
+        assert len(admits) == 1
+        (group, *_rest) = admits[0]
+        assert [r.out for r in group] == [q_live]
+        snap = eng.stats.snapshot()
+        assert snap["deadline_expired_total"] == 1
+        assert snap["shed_total"] == 1
+        assert eng.debug_pilot()["edf"]["expired_at_pop"] == 1
+    finally:
+        eng.stop()
+
+
 def test_default_deadline_applies_when_request_sets_none():
     eng = _engine(start=False, default_deadline_ms=1)
     q = eng.submit([3, 4], SamplingParams(temperature=0.0, max_new_tokens=4))

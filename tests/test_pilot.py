@@ -1,0 +1,440 @@
+"""graftpilot tests: bounded feedback control with a decision ledger.
+
+The load-bearing claims, in test form:
+ * env gating follows the None-attribute idiom (PILOT) — a disabled
+   engine keeps the raw dispatch path and ``debug_pilot() is None``;
+   ``PILOT=hold`` flies EDF + the ledger with every knob frozen;
+ * the control loop CONVERGES in both directions per knob — budget
+   raises under starvation and lowers under surplus, admit halves under
+   pool pressure and recovers after calm, bias drops under deadline
+   expiry and relaxes after meeting — each from injected signal
+   windows, no engine required;
+ * it can NEVER misbehave: the first window only baselines, cooldowns
+   block back-to-back moves, recovery needs consecutive calm windows
+   (hysteresis), and at an envelope bound the rule goes silent instead
+   of oscillating;
+ * EDF ordering is stable, counts inversions, ages no-deadline
+   requests via a virtual deadline (starvation-proof), and returns the
+   SAME deque object for an already-ordered queue — the all-FIFO
+   workload's dispatch stays byte-identical;
+ * the pilot is pure observation at fixed knobs: greedy outputs are
+   BIT-IDENTICAL pilot-on-vs-off across all three dispatch paths;
+ * a mixed-deadline soak under the pilot keeps the conservation audit
+   clean, every knob inside its envelope, and the engine leak-free.
+"""
+
+import collections
+import os
+import time
+import types
+
+import jax
+import pytest
+
+from seldon_tpu.models import init_params
+from seldon_tpu.models.config import get_config
+from seldon_tpu.models.sampling import SamplingParams
+from seldon_tpu.servers import controller
+from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+GREEDY = SamplingParams(temperature=0.0, max_new_tokens=6)
+PROMPTS = [list(range(2, 2 + n)) for n in (5, 12, 24, 7)]
+
+# The three dispatch paths whose outputs the pilot must not perturb.
+MODES = {
+    "dense": {},
+    "paged": dict(paged_kv=True, kv_block=16, kv_pool_blocks=12,
+                  prompt_buckets=(16, 32)),
+    "chunked": dict(chunked_prefill=True, prefill_chunk=8, prefix_block=8),
+}
+
+
+def _engine(start=True, **ekw):
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    ekw.setdefault("max_slots", 4)
+    ekw.setdefault("max_seq_len", 64)
+    ekw.setdefault("prompt_buckets", (8, 32))
+    eng = InferenceEngine(params, cfg, EngineConfig(**ekw))
+    if start:
+        eng.start()
+    return eng
+
+
+def _collect(eng, prompts):
+    qs = [eng.submit(p, GREEDY) for p in prompts]
+    outs = []
+    for q in qs:
+        toks = []
+        while True:
+            item = q.get(timeout=300)
+            if item is None:
+                break
+            toks.extend(item["tokens"])
+        outs.append(toks)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Signal injection harness (no engine: the controller sees only dicts)
+# ---------------------------------------------------------------------------
+
+
+class _Signals:
+    """Cumulative signal source; tests advance() it between windows."""
+
+    def __init__(self, **levels):
+        self.cum = {k: 0 for k in controller._DELTA_KEYS}
+        self.levels = {"goodput": 1.0, "queue_depth": 0, "free_slots": 4}
+        self.levels.update(levels)
+
+    def advance(self, **vals):
+        for k, v in vals.items():
+            if k in self.cum:
+                self.cum[k] += v
+            else:
+                self.levels[k] = v
+
+    def __call__(self):
+        out = dict(self.cum)
+        out.update(self.levels)
+        return out
+
+
+def _pilot(hold=False, budget=8):
+    p = controller.PilotController(hold=hold)
+    p.bind(chunked=True, prefill_chunk=8, max_slots=4, max_admit=4,
+           dispatch_token_budget=budget)
+    return p
+
+
+def _window(pilot, sig):
+    """Run one full decision window; return the decisions it took."""
+    out = []
+    for _ in range(controller.PERIOD_BOUNDARIES):
+        out += pilot.on_boundary(sig)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Gating
+# ---------------------------------------------------------------------------
+
+
+def test_from_env_gating(monkeypatch):
+    monkeypatch.delenv("PILOT", raising=False)
+    assert controller.from_env() is None
+    monkeypatch.setenv("PILOT", "0")
+    assert controller.from_env() is None
+    monkeypatch.setenv("PILOT", "1")
+    p = controller.from_env()
+    assert p is not None and p.hold is False
+    monkeypatch.setenv("PILOT", "hold")
+    p = controller.from_env()
+    assert p is not None and p.hold is True
+
+
+def test_disabled_engine_keeps_raw_path(monkeypatch):
+    monkeypatch.delenv("PILOT", raising=False)
+    eng = _engine(start=False)
+    try:
+        assert eng._pilot is None
+        assert eng.debug_pilot() is None
+        with eng._book:
+            # The admit cap resolves to the static config value — the
+            # raw dispatch path, zero controller involvement.
+            assert eng._admit_cap() == eng._max_admit
+    finally:
+        eng.stop()
+
+
+def test_pilot_implies_sched_ledger(monkeypatch):
+    monkeypatch.delenv("SCHED_LEDGER", raising=False)
+    monkeypatch.setenv("PILOT", "1")
+    eng = _engine(start=False)
+    try:
+        assert eng._pilot is not None
+        assert eng._sled is not None  # the controller's signal source
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Convergence: every knob moves in both directions from injected signals
+# ---------------------------------------------------------------------------
+
+
+def test_first_window_only_baselines():
+    p = _pilot()
+    sig = _Signals()
+    sig.advance(budget_dispatches=8, budget_starved_passes=8,
+                budget_offered_tokens=64, budget_used_tokens=64)
+    assert _window(p, sig) == []  # nothing to delta against yet
+    assert p.snapshot()["windows"] == 1
+
+
+def test_budget_raises_under_starvation():
+    p = _pilot()
+    sig = _Signals()
+    _window(p, sig)  # baseline
+    sig.advance(budget_dispatches=4, budget_starved_passes=4,
+                budget_offered_tokens=32, budget_used_tokens=32,
+                queue_depth=6)
+    (d,) = _window(p, sig)
+    assert d["knob"] == controller.KNOB_BUDGET
+    assert (d["old"], d["new"]) == (8, 16)
+    assert "starved" in d["rationale"]
+    assert d["expected_effect"]
+    assert d["signal_snapshot"]["budget_starved_passes"] == 4
+    assert d["effect"] is None  # effect window still open
+    assert p.dispatch_budget() == 16
+    snap = p.snapshot()
+    assert snap["decisions_total"] == 1
+    assert snap["decisions_by_knob"][controller.KNOB_BUDGET] == 1
+
+
+def test_budget_lowers_under_surplus():
+    p = _pilot(budget=32)
+    sig = _Signals()
+    _window(p, sig)  # baseline
+    # 0/8 starved passes at 25% utilization: clear surplus.
+    sig.advance(budget_dispatches=8, budget_offered_tokens=256,
+                budget_used_tokens=64)
+    (d,) = _window(p, sig)
+    assert d["knob"] == controller.KNOB_BUDGET
+    assert (d["old"], d["new"]) == (32, 16)
+    assert "surplus" in d["rationale"]
+    assert p.dispatch_budget() == 16
+
+
+def test_budget_cooldown_then_stable_at_clamp():
+    p = _pilot()  # envelope [8, 32]
+    sig = _Signals()
+    budgets = []
+    for _ in range(8):
+        sig.advance(budget_dispatches=4, budget_starved_passes=4,
+                    budget_offered_tokens=32, budget_used_tokens=32)
+        _window(p, sig)
+        budgets.append(p.dispatch_budget())
+    # Baseline, raise, 2-window cooldown, raise to the clamp, then
+    # silence: permanent starvation cannot push past the envelope and
+    # the controller never oscillates at the bound.
+    assert budgets == [8, 16, 16, 32, 32, 32, 32, 32]
+    snap = p.snapshot()
+    assert snap["decisions_total"] == 2
+    assert snap["knobs"]["dispatch_token_budget"] == snap["envelope"]["budget_max"]
+
+
+def test_admit_halves_on_stall_then_recovers():
+    p = _pilot()
+    sig = _Signals()
+    _window(p, sig)  # baseline
+    sig.advance(pool_stall_events=2, preemptions=1)
+    (d,) = _window(p, sig)
+    assert d["knob"] == controller.KNOB_ADMIT
+    assert (d["old"], d["new"]) == (4, 2)
+    assert "pool pressure" in d["rationale"]
+    assert p.admit_cap() == 2
+    # One calm window is NOT enough (cooldown + hysteresis overlap);
+    # the second calm window recovers.
+    assert _window(p, sig) == []
+    (d,) = _window(p, sig)
+    assert d["knob"] == controller.KNOB_ADMIT
+    assert (d["old"], d["new"]) == (2, 4)
+    assert p.admit_cap() == 4
+
+
+def test_bias_drops_on_expiry_then_relaxes():
+    p = _pilot()
+    sig = _Signals()
+    _window(p, sig)  # baseline
+    sig.advance(deadline_expired=3)
+    (d,) = _window(p, sig)
+    assert d["knob"] == controller.KNOB_BIAS
+    assert (d["old"], d["new"]) == (0, -1)
+    assert p.chunk_bias() == -1
+    assert _window(p, sig) == []  # cooldown + single meet window
+    (d,) = _window(p, sig)
+    assert (d["old"], d["new"]) == (-1, 0)
+    assert p.chunk_bias() == 0
+    # Bias relaxes only back toward neutral — never above 0.
+    for _ in range(4):
+        assert _window(p, sig) == []
+    assert p.chunk_bias() == 0
+
+
+def test_counterfactual_effect_fills_next_window():
+    p = _pilot()
+    sig = _Signals()
+    _window(p, sig)
+    sig.advance(budget_dispatches=4, budget_starved_passes=4,
+                budget_offered_tokens=32, budget_used_tokens=32)
+    (d,) = _window(p, sig)
+    assert d["effect"] is None
+    sig.advance(goodput=0.75)  # next window measures the move
+    _window(p, sig)
+    entry = p.snapshot()["ledger"][0]
+    assert entry["effect"] is not None
+    assert entry["effect"]["goodput_delta"] == pytest.approx(-0.25)
+    cf = p.snapshot()["counterfactual"]
+    assert cf["windows"] == 1
+    assert cf["goodput_delta"] == pytest.approx(-0.25)
+
+
+def test_hold_mode_freezes_knobs():
+    p = _pilot(hold=True)
+    sig = _Signals()
+    for _ in range(4):
+        sig.advance(budget_dispatches=4, budget_starved_passes=4,
+                    budget_offered_tokens=32, budget_used_tokens=32,
+                    pool_stall_events=1, deadline_expired=1)
+        assert _window(p, sig) == []
+    snap = p.snapshot()
+    assert snap["mode"] == "hold"
+    assert snap["windows"] == 4  # the ledger half still flies
+    assert snap["decisions_total"] == 0
+    assert snap["knobs"] == {"dispatch_token_budget": 8, "max_admit": 4,
+                             "chunk_bias": 0}
+
+
+# ---------------------------------------------------------------------------
+# EDF ordering
+# ---------------------------------------------------------------------------
+
+
+def _req(deadline=None, submitted_at=None):
+    return types.SimpleNamespace(
+        deadline=deadline,
+        submitted_at=time.perf_counter() if submitted_at is None
+        else submitted_at,
+    )
+
+
+def test_edf_sorts_by_deadline_counts_inversions():
+    p = _pilot()
+    now = time.perf_counter()
+    a = _req(deadline=now + 9.0)
+    b = _req(deadline=now + 1.0)
+    c = _req(deadline=now + 5.0)
+    out = p.order_queue(collections.deque([a, b, c]))
+    assert list(out) == [b, c, a]
+    snap = p.snapshot()["edf"]
+    assert snap["inversions"] == 1  # one out-of-order adjacent pair (a,b)
+    assert snap["reorders"] == 1
+
+
+def test_edf_fifo_queue_returned_untouched():
+    p = _pilot()
+    now = time.perf_counter()
+    q = collections.deque(
+        _req(submitted_at=now + i * 0.001) for i in range(5)
+    )
+    out = p.order_queue(q)
+    assert out is q  # the SAME object: FIFO dispatch stays byte-identical
+    assert p.snapshot()["edf"] == {"inversions": 0, "reorders": 0,
+                                   "expired_at_pop": 0}
+
+
+def test_edf_aging_outranks_far_deadline():
+    p = _pilot()
+    now = time.perf_counter()
+    aged = _req(submitted_at=now - 2 * controller.AGE_HORIZON_S)
+    urgent = _req(deadline=now + 5.0)
+    out = p.order_queue(collections.deque([urgent, aged]))
+    # The aged no-deadline request's virtual deadline (submit + horizon)
+    # is already in the past — it outranks any future deadline, so
+    # starvation is impossible.
+    assert list(out) == [aged, urgent]
+
+
+def test_edf_stable_on_equal_keys():
+    p = _pilot()
+    now = time.perf_counter()
+    x = _req(deadline=now + 3.0)
+    y = _req(deadline=now + 3.0)
+    late = _req(deadline=now + 1.0)
+    out = p.order_queue(collections.deque([x, y, late]))
+    assert list(out) == [late, x, y]  # ties keep FIFO order
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_greedy_bit_identical_pilot_on_vs_off(mode, monkeypatch):
+    monkeypatch.delenv("PILOT", raising=False)
+    monkeypatch.delenv("SCHED_LEDGER", raising=False)
+    eng = _engine(**MODES[mode])
+    try:
+        want = _collect(eng, PROMPTS)
+        assert eng.debug_pilot() is None
+    finally:
+        eng.stop()
+
+    monkeypatch.setenv("PILOT", "1")
+    eng = _engine(**MODES[mode])
+    try:
+        got = _collect(eng, PROMPTS)
+        eng.drain(timeout=120)
+        pilot = eng.debug_pilot()
+        sched = eng.debug_sched()
+    finally:
+        eng.stop()
+
+    assert got == want, f"{mode}: pilot perturbed greedy output"
+    assert pilot["enabled"] is True
+    assert pilot["boundaries"] > 0
+    # PILOT implied the sched ledger; its books stayed clean.
+    assert sched["conservation"]["breaches"] == 0, (
+        sched["conservation"]["last_breach"])
+
+
+@pytest.mark.fuzz
+def test_mixed_deadline_soak_conserves(monkeypatch):
+    """Soak the pilot with a deadline-mixed wave on the chunked engine:
+    generous TTLs, tight TTLs (some expire) and no-TTL requests
+    interleaved. Whatever the controller decides, the conservation
+    audit stays clean, every knob stays inside its envelope, and the
+    engine ends leak-free."""
+    monkeypatch.setenv("PILOT", "1")
+    n = max(12, int(os.environ.get("FUZZ_EXAMPLES", "300")) // 12)
+    eng = _engine(chunked_prefill=True, prefill_chunk=8, prefix_block=8,
+                  max_queue=4 * n)
+    try:
+        qs = []
+        for i in range(n):
+            ttl = (0, 30_000, 20)[i % 3]  # none / generous / likely-expired
+            qs.append(eng.submit(
+                list(range(2, 2 + 5 + (i % 19))),
+                SamplingParams(temperature=0.0, max_new_tokens=4,
+                               deadline_ms=ttl),
+            ))
+        done = expired = 0
+        for q in qs:
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    break
+                if "error" in item:
+                    assert item["kind"] == "deadline", item
+                    expired += 1
+            done += 1
+        assert done == n
+        eng.drain(timeout=120)
+        pilot = eng.debug_pilot()
+        sched = eng.debug_sched()
+        assert sched["conservation"]["checked"] > 0
+        assert sched["conservation"]["breaches"] == 0, (
+            sched["conservation"]["last_breach"])
+        env = pilot["envelope"]
+        knobs = pilot["knobs"]
+        assert env["budget_min"] <= knobs["dispatch_token_budget"] \
+            <= env["budget_max"]
+        assert env["admit_min"] <= knobs["max_admit"] <= env["admit_max"]
+        assert env["bias_min"] <= knobs["chunk_bias"] <= env["bias_max"]
+        assert isinstance(pilot["edf"]["expired_at_pop"], int)
+        assert eng.debug_lifecycle_check() == {}
+    finally:
+        eng.stop()

@@ -44,7 +44,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # Substring -> direction tables, checked against the LAST path segment
 # so "detail.chunked.p50_ttft_ms" gates on "p50_ttft_ms".
-_LOWER = ("ms", "latency", "stall", "frag", "dropped", "error")
+_LOWER = ("ms", "latency", "stall", "frag", "dropped", "error",
+          "inversions")
 _HIGHER = ("req_per_s", "req_s", "tokens_per_s", "speedup", "hit_rate",
            "goodput", "coverage")
 # Exact leaf-name matches for the headline numbers.

@@ -139,6 +139,17 @@ KNOBS = {
                        "conservation audit run under the bookkeeping "
                        "lock. Served at /debug/sched; gated by `make "
                        "sched-audit`."),
+    "PILOT": _k("runtime", "0",
+                "Enable graftpilot, the scheduler's feedback controller: "
+                "\"1\" auto-tunes dispatch_token_budget / admission group "
+                "size / the adaptive-chunk rung from the sched ledger's "
+                "stall-vs-contention split (hysteresis, clamped envelope, "
+                "cooldowns) and schedules EDF deadline-first with "
+                "starvation-proof aging; \"hold\" keeps EDF + the decision "
+                "ledger but freezes every knob (operator pinning). "
+                "Implies a sched ledger. Every decision lands in the "
+                "/debug/pilot ledger with its signal snapshot, rationale "
+                "and counterfactual effect; gated by `make pilot-audit`."),
     "DISPATCH_TIMING": _k("runtime", "0",
                           "Per-variant dispatch duration histograms, "
                           "measured at the scheduler's deliberate sync "
@@ -292,6 +303,11 @@ KNOBS = {
     "BENCH_SLO_CHUNK": _k("bench-harness", "0 (adaptive)",
                           "Pin a fixed dispatch chunk for the SLO search "
                           "instead of occupancy-adaptive chunking."),
+    "BENCH_PILOT": _k("bench-harness", "0",
+                      "Run the pilot phase: a mixed-deadline closed wave "
+                      "twice at equal hardware — PILOT=1 vs pilot off — "
+                      "reporting slo_goodput, decision count, EDF "
+                      "inversions and final knob values for both legs."),
     "BENCH_SECOND_PRESET": _k("bench-harness",
                               "bench-1b for llama3-8b, else (empty)",
                               "Trailing deployment-proxy preset; empty "

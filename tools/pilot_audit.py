@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""CI pilot audit: graftpilot flies a real server end to end.
+
+Boots the tiny warmed JAXServer (chunked prefill, so the budget knob is
+live) behind the real REST app with ``PILOT=1`` + ``GRAFTSAN=1`` +
+``FLIGHT_RECORDER=1``, polls ``/debug/pilot`` on the idle engine, then
+drives a short mixed-deadline closed-loop loadtester run and asserts
+the controller contract in one pass:
+
+ * idle engine -> the documented schema with ZERO boundaries, windows
+   and decisions, and every knob already inside its clamp envelope;
+ * under load the controller CONVERGES: at least one decision lands in
+   the ledger (the tiny budget is deterministically starved by
+   multi-chunk prompts), every entry carries a non-empty rationale +
+   signal snapshot, and every live knob stays inside the envelope;
+ * the books stay clean while the pilot flies: the sched ledger
+   (implied by PILOT) reports zero conservation breaches and the
+   runtime sanitizer reports zero lock-contract violations;
+ * the loadtester's ``/debug/pilot`` poll agrees with the route
+   (decision counts can only grow between the two reads), and the
+   jaxserver Prometheus surface exports the ``jaxserver_pilot_*``
+   gauges;
+ * decisions land as flight-recorder "pilot" records and
+   ``tools/trace_view.py`` renders the decision lane + knob counters.
+
+Run via ``make pilot-audit`` (wired into ``make ci``); exits non-zero
+with a one-line diagnosis on the first failed check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+# Frozen /debug/pilot top-level key set — tests/test_debug_schema.py
+# carries the same golden; a mismatch here means the snapshot schema
+# changed without updating its consumers.
+PILOT_TOP_KEYS = frozenset({
+    "enabled", "mode", "boundaries", "windows", "period_boundaries",
+    "decisions_total", "decisions_by_knob", "knobs", "envelope", "edf",
+    "counterfactual", "ledger",
+})
+PILOT_LEDGER_KEYS = frozenset({
+    "ts", "knob", "old", "new", "rationale", "expected_effect",
+    "signal_snapshot", "effect",
+})
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        print(f"pilot-audit FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _knobs_in_envelope(pilot: dict) -> None:
+    env = pilot["envelope"]
+    knobs = pilot["knobs"]
+    _check(
+        env["budget_min"] <= knobs["dispatch_token_budget"]
+        <= env["budget_max"],
+        f"budget {knobs['dispatch_token_budget']} left the envelope "
+        f"[{env['budget_min']}, {env['budget_max']}]",
+    )
+    _check(
+        env["admit_min"] <= knobs["max_admit"] <= env["admit_max"],
+        f"max_admit {knobs['max_admit']} left the envelope "
+        f"[{env['admit_min']}, {env['admit_max']}]",
+    )
+    _check(knobs["max_admit"] & (knobs["max_admit"] - 1) == 0,
+           f"max_admit {knobs['max_admit']} is not a power of two")
+    _check(
+        env["bias_min"] <= knobs["chunk_bias"] <= env["bias_max"],
+        f"chunk_bias {knobs['chunk_bias']} left the envelope "
+        f"[{env['bias_min']}, {env['bias_max']}]",
+    )
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PILOT"] = "1"
+    os.environ["GRAFTSAN"] = "1"
+    os.environ["FLIGHT_RECORDER"] = "1"
+
+    import asyncio
+    import threading
+    import urllib.request
+
+    from aiohttp import web
+
+    from seldon_tpu.loadtester import main as lt_main
+    from seldon_tpu.runtime.wrapper import build_rest_app
+    from seldon_tpu.servers.jaxserver import JAXServer
+    from tools import trace_view
+
+    # Chunked prefill with the minimum legal chunk (16 = prefix_block)
+    # and the default budget (= one chunk): the loadtester's multi-chunk
+    # prompts then starve the budget deterministically, so the
+    # convergence check below observes a real control decision, not a
+    # lucky race.
+    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=128,
+                    warmup=1, chunked_prefill=1, prefill_chunk=16)
+    srv.load()
+
+    holder, started = {}, threading.Event()
+
+    async def amain() -> None:
+        runner = web.AppRunner(build_rest_app(srv))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    _check(started.wait(60), "REST app failed to start within 60s")
+    url = f"http://127.0.0.1:{holder['port']}"
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(url + path, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # --- idle engine: schema + neutral state ------------------------
+        idle = get("/debug/pilot")
+        _check(set(idle) == PILOT_TOP_KEYS,
+               f"/debug/pilot keys drifted: got {sorted(idle)}")
+        _check(idle["enabled"] is True, "idle pilot reports enabled=false")
+        _check(idle["mode"] == "auto", f"idle mode = {idle['mode']}")
+        _check(idle["boundaries"] == 0,
+               f"idle engine counted {idle['boundaries']} boundaries")
+        _check(idle["decisions_total"] == 0,
+               f"idle engine took {idle['decisions_total']} decisions")
+        _check(idle["ledger"] == [], "idle engine has ledger entries")
+        _knobs_in_envelope(idle)
+
+        # --- mixed-deadline load window ---------------------------------
+        # ~64-byte prompts = 4 prefill chunks each; 3 s TTL on half the
+        # requests gives the EDF queue real deadline/no-deadline mixing.
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            lt_main([
+                url, "--transport", "generate", "--clients", "8",
+                "--seconds", "3",
+                "--prompt", "p" * 64,
+                "--max-new-tokens", "8",
+                "--deadline-ms", "3000", "--deadline-frac", "0.5",
+            ])
+        ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
+        detail = ledger["detail"]
+        _check(detail["requests"] >= 1, "loadtester completed no requests")
+        _check("pilot_decisions" in detail,
+               "loadtester ledger carries no pilot counters")
+
+        pilot = get("/debug/pilot")
+        sched = get("/debug/sched")
+        snap = get("/debug/timeline")
+    finally:
+        holder["stop"] = True
+        t.join(timeout=10)
+
+    # --- convergence: the controller actually decided -------------------
+    _check(set(pilot) == PILOT_TOP_KEYS,
+           f"/debug/pilot keys drifted: got {sorted(pilot)}")
+    _check(pilot["boundaries"] > 0, "pilot observed no boundaries")
+    _check(pilot["windows"] > 0, "pilot closed no decision windows")
+    _check(
+        pilot["decisions_total"] >= 1,
+        f"controller never converged to a decision "
+        f"({pilot['windows']} windows, knobs {pilot['knobs']})",
+    )
+    _check(len(pilot["ledger"]) >= 1, "decision ledger is empty")
+    for entry in pilot["ledger"]:
+        _check(set(entry) == PILOT_LEDGER_KEYS,
+               f"ledger entry keys drifted: got {sorted(entry)}")
+        _check(bool(entry["rationale"]),
+               f"decision on {entry['knob']} carries no rationale")
+        _check(bool(entry["signal_snapshot"]),
+               f"decision on {entry['knob']} carries no signal snapshot")
+        _check(entry["old"] != entry["new"],
+               f"no-op decision recorded on {entry['knob']}")
+    _knobs_in_envelope(pilot)
+    _check(
+        pilot["decisions_total"] == sum(
+            pilot["decisions_by_knob"].values()
+        ),
+        "decisions_by_knob does not re-sum to decisions_total",
+    )
+
+    # --- books stay clean under the controller --------------------------
+    cons = sched["conservation"]
+    _check(cons["checked"] > 0, "conservation audit never ran")
+    _check(
+        cons["breaches"] == 0,
+        f"{cons['breaches']} conservation breaches under the pilot: "
+        f"{cons['last_breach']}",
+    )
+    san = srv.engine._san
+    _check(san is not None, "GRAFTSAN=1 but the engine has no sanitizer")
+    _check(
+        not san.violations,
+        f"graftsan violations under the pilot: {san.violations}",
+    )
+
+    # --- loadtester ledger / route parity -------------------------------
+    # The route poll ran after the loadtester's; trailing in-flight
+    # decode can only ADD decisions/boundaries between the two reads.
+    _check(
+        detail["pilot_decisions"] <= pilot["decisions_total"],
+        f"ledger pilot_decisions {detail['pilot_decisions']} > route "
+        f"{pilot['decisions_total']}",
+    )
+    _check(
+        detail["pilot_edf_inversions"] <= pilot["edf"]["inversions"],
+        f"ledger inversions {detail['pilot_edf_inversions']} > route "
+        f"{pilot['edf']['inversions']}",
+    )
+
+    # --- Prometheus surface ---------------------------------------------
+    gauges = {m["key"] for m in srv.metrics()}
+    for key in ("jaxserver_pilot_decisions_total",
+                "jaxserver_pilot_budget_current",
+                "jaxserver_pilot_edf_inversions",
+                "jaxserver_pilot_goodput_delta"):
+        _check(key in gauges, f"metrics() missing gauge {key}")
+
+    # --- flight recorder + trace_view decision lane ---------------------
+    pilot_recs = [r for r in snap.get("records", [])
+                  if r["kind"] == "pilot"]
+    _check(pilot_recs, "no pilot records in the timeline")
+    for r in pilot_recs:
+        d = r.get("detail") or {}
+        _check("knob" in d and "rationale" in d,
+               f"pilot record missing knob/rationale: {sorted(d)}")
+    out = json.loads(json.dumps(trace_view.convert(snap)))
+    lanes = {e["args"]["name"] for e in out["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    _check("seldon-tpu pilot" in lanes,
+           f"trace_view rendered no pilot process (got {lanes})")
+    counters = {e["name"] for e in out["traceEvents"] if e["ph"] == "C"}
+    _check("pilot_budget" in counters,
+           f"trace_view rendered no pilot knob counters (got {counters})")
+
+    srv.engine.stop()
+
+    print(json.dumps({
+        "metric": "pilot_audit",
+        "value": 1,
+        "detail": {
+            "requests": detail["requests"],
+            "boundaries": pilot["boundaries"],
+            "windows": pilot["windows"],
+            "decisions_total": pilot["decisions_total"],
+            "decisions_by_knob": pilot["decisions_by_knob"],
+            "final_knobs": pilot["knobs"],
+            "edf": pilot["edf"],
+            "counterfactual": pilot["counterfactual"],
+            "conservation_checked": cons["checked"],
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,13 @@ Rendering model:
    ("admit/32/4", "decode/8", ...), spanning dispatch -> boundary so
    per-variant device occupancy reads directly off the track;
  * "retrace" records (COMPILE_LEDGER=1) are the live-retrace
-   witnesses — rendered as instants on the paying request's track.
+   witnesses — rendered as instants on the paying request's track;
+ * "pilot" records (PILOT=1) are the controller's decisions — rendered
+   as a dedicated decision lane on a third "pilot" process ("budget
+   128->256" instants carrying the full rationale in args) plus "C"
+   counter series for the live knob values (`pilot_budget`,
+   `pilot_max_admit`, `pilot_chunk_bias`), so control actions line up
+   against the boundary/waste counters they reacted to.
 
 Monotonic record timestamps convert to wall-clock microseconds via the
 snapshot's epoch pairing, so the device profile captured by
@@ -54,6 +60,9 @@ _INSTANTS = (
 )
 # Per-variant dispatch lanes live on their own process row.
 _VARIANT_PID = 2
+# Pilot decisions get their own process row: a decision lane + knob
+# counters, visually separate from both requests and variants.
+_PILOT_PID = 3
 
 
 def _wall_us(snapshot: Dict[str, Any], ts: float) -> float:
@@ -76,6 +85,21 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
     # variant key -> lane tid on the variants process (pid 2), assigned
     # in first-seen order so lanes are stable within one recording.
     variant_tids: Dict[str, int] = {}
+    pilot_named = False
+
+    def pilot_track() -> int:
+        nonlocal pilot_named
+        if not pilot_named:
+            pilot_named = True
+            events.append({
+                "ph": "M", "pid": _PILOT_PID, "name": "process_name",
+                "args": {"name": "seldon-tpu pilot"},
+            })
+            events.append({
+                "ph": "M", "pid": _PILOT_PID, "tid": 0,
+                "name": "thread_name", "args": {"name": "decisions"},
+            })
+        return 0
 
     def variant_track(key: str) -> int:
         tid = variant_tids.get(key)
@@ -166,6 +190,21 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                     "ph": "C", "pid": 1, "name": "padding_waste_frac",
                     "ts": ts, "args": {"frac": detail["waste_frac"]},
                 })
+        elif kind == "pilot":
+            knob = detail.get("knob", "?")
+            events.append({
+                "ph": "i", "pid": _PILOT_PID, "tid": pilot_track(),
+                "name": f"{knob} {detail.get('old')}->{detail.get('new')}",
+                "ts": ts, "s": "p", "args": detail,
+            })
+            for name, key in (("pilot_budget", "budget"),
+                              ("pilot_max_admit", "max_admit"),
+                              ("pilot_chunk_bias", "chunk_bias")):
+                if key in detail:
+                    events.append({
+                        "ph": "C", "pid": _PILOT_PID, "name": name,
+                        "ts": ts, "args": {"value": detail[key]},
+                    })
         else:
             events.append({
                 "ph": "i", "pid": 1, "tid": track(rid), "name": kind,
