@@ -96,6 +96,14 @@ PAGED = os.environ.get("BENCH_PAGED", "0") == "1"
 # and final knob values (tools/bench_compare.py gates slo_goodput
 # higher-is-better and pilot_edf_inversions lower-is-better).
 PILOT_PHASE = os.environ.get("BENCH_PILOT", "0") == "1"
+# Ragged phase: the same mixed-length closed wave run twice at equal
+# hardware — graftragged unified dispatch (RAGGED=1 semantics) vs the
+# bucketed lattice — so the bench line carries per-leg req/s and
+# padding_waste_frac, the ragged leg's compile-variant count (strictly
+# gated by tools/bench_compare.py), and the measured ragged req/s
+# against the bucketed leg's own waste_roofline prediction. Recorded in
+# detail.ragged.
+RAGGED_PHASE = os.environ.get("BENCH_RAGGED", "0") == "1"
 PAGED_DENSE_SLOTS = int(os.environ.get("BENCH_PAGED_DENSE_SLOTS", "4"))
 PAGED_KV_BLOCK = int(os.environ.get("BENCH_PAGED_KV_BLOCK", "16"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
@@ -1085,6 +1093,96 @@ def _measure_paged(params, cfg) -> dict:
     }
 
 
+def _measure_ragged(params, cfg) -> dict:
+    """BENCH_RAGGED phase: one mixed-length closed wave run twice at
+    equal hardware — the bucketed lattice vs graftragged's unified
+    dispatch, both on the same paged + chunked substrate, same pool,
+    same slots. The bucketed leg's sched ledger prices the padding its
+    buckets and pow2 groups paid AND emits the waste_roofline
+    prediction (req/s at zero padding); the ragged leg then has to cash
+    that prediction on the same wave: the report carries per-leg req/s
+    + padding_waste_frac, the ragged leg's compile-variant count
+    (collapse contract: ≤ 2, gated strictly by bench_compare), and
+    ragged_vs_roofline — measured over predicted."""
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    bs = 16          # KV block
+    chunk = 32       # ragged segment / prefill chunk (pow2, bs-aligned)
+    new_toks = min(NEW_TOKENS, 16)
+    slots = 8
+    # Mixed lengths straddling the bucket grid: the bucketed leg rounds
+    # 24->32 and 48/96->128 and pads pow2 admission groups; the ragged
+    # leg packs the exact counts.
+    lengths = [24, 48, 96, 16]
+    smax = 128  # max prompt 96 + 16 new + slack, block-aligned
+    n_req = 3 * slots
+    pool_blocks = slots * (smax // bs) + 1  # full residency + trash
+    rng = np.random.default_rng(29)
+    prompts = [
+        rng.integers(3, cfg.vocab_size,
+                     size=(lengths[i % len(lengths)],)).tolist()
+        for i in range(n_req)
+    ]
+
+    def leg(ragged: bool):
+        ecfg = EngineConfig(
+            max_slots=slots,
+            max_seq_len=smax,
+            prompt_buckets=(32, 128),
+            max_admit=4,
+            decode_chunk=4,
+            paged_kv=True, kv_block=bs, kv_pool_blocks=pool_blocks,
+            chunked_prefill=True, prefill_chunk=chunk, prefix_block=bs,
+            ragged=ragged,
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+        engine.warmup()
+        engine.start()
+        t0 = time.perf_counter()
+        qs = [engine.submit(p, SamplingParams(
+                  temperature=0.7, top_k=0, top_p=1.0,
+                  max_new_tokens=new_toks, seed=i))
+              for i, p in enumerate(prompts)]
+        for q in qs:
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    break
+                if "error" in item:
+                    raise RuntimeError(item["error"])
+        dt = time.perf_counter() - t0
+        req_s = n_req / dt
+        out = {
+            "req_per_s": round(req_s, 3),
+            "makespan_s": round(dt, 3),
+            **_compile_counts(engine),
+            **_sched_counts(engine, req_s=req_s),
+        }
+        engine.stop()
+        return out
+
+    bucketed = leg(ragged=False)
+    ragged_leg = leg(ragged=True)
+    roofline = bucketed.get("waste_roofline", {}).get(
+        "ragged_attention_req_s", 0.0)
+    return {
+        "bucketed": bucketed,
+        "ragged": ragged_leg,
+        "speedup": (round(ragged_leg["req_per_s"]
+                          / bucketed["req_per_s"], 3)
+                    if bucketed["req_per_s"] else None),
+        "roofline_req_s": roofline,
+        # Measured over predicted: ~1.0 means the unified kernel cashed
+        # exactly the padding the bucketed leg paid; < 1.0 is the gap
+        # the wave kernel itself still owes.
+        "ragged_vs_roofline": (round(ragged_leg["req_per_s"] / roofline, 3)
+                               if roofline else None),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1160,6 +1258,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"pilot phase failed: {e!r}")
             detail["pilot_error"] = str(e)
+
+    if RAGGED_PHASE:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["ragged"] = _measure_ragged(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"ragged phase failed: {e!r}")
+            detail["ragged_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

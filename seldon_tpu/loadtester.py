@@ -351,8 +351,18 @@ def _compile_counts(url: str) -> dict:
             url.rstrip("/") + "/debug/compile", timeout=2
         ) as resp:
             comp = json.loads(resp.read())
+        # Per-family counts alongside the total: a key's family is its
+        # first '/'-segment ("admit-prefix/64/16/1" -> "admit-prefix"),
+        # so the graftragged collapse is legible in the post-run ledger
+        # — a ragged run shows {"deactivate": 1, "ragged": 1} where the
+        # bucketed lattice fans out per family.
+        by_family: dict = {}
+        for entry in comp.get("lattice", []):
+            fam = str(entry["key"]).split("/", 1)[0]
+            by_family[fam] = by_family.get(fam, 0) + 1
         return {
             "compile_variants": int(comp["dispatched_variants"]),
+            "compile_variants_by_family": dict(sorted(by_family.items())),
             "live_retraces": int(comp["live_retrace_count"]),
             "compile_s_total": float(comp["compile_s_total"]),
         }

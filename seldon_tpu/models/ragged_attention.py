@@ -1,0 +1,241 @@
+"""graftragged — shape-stable ragged unified-batch attention wave.
+
+One kernel, one compiled dispatch, no bucket lattice. Every scheduler
+wave runs this single fused function over ALL slots: mixed cold
+prefills, chunked prefill continuations, prefix-warm resumes and decode
+steps ride the same dispatch, so the engine compiles exactly ONE
+variant — key ``("ragged", chunk)`` — instead of one per
+(prefix bucket, suffix bucket, pow2 group) cell (the Ragged Paged
+Attention design, PAPERS.md).
+
+Wave layout (all shapes are config constants — nothing about the live
+mix appears in any array shape):
+
+ * ``tokens``: the flat ``[max_tokens]`` token buffer with
+   ``max_tokens = max_slots * chunk`` — slot ``s`` owns the fixed
+   segment ``[s * chunk, (s + 1) * chunk)`` (fixed stride keeps the
+   buffer shape-stable AND makes the per-slot view a free reshape; a
+   packed variable-stride buffer would need a gather keyed on the mix).
+ * per-slot descriptors, each ``[max_slots]``: ``starts`` (tokens of
+   the request already KV-resident — prior chunks plus any zero-copy
+   prefix-trie hit; this wave's segment lands at absolute positions
+   ``start + i``), ``plens`` (full prompt length, so
+   ``kv_len = min(plens, starts + chunk)`` after the wave), sampling
+   knobs (seed/temp/top_k/top_p/max_new), ``finals`` (this wave
+   completes the row's prompt: sample its first token), and
+   ``is_prefill`` (the occupancy mask — rows NOT prefilling this wave
+   keep their state bit-for-bit and their KV writes route to the
+   trash block).
+ * ``table``: the ``[max_slots, max_seq_len // kv_block]`` paged block
+   tables — block tables are the wave's only KV currency, which is why
+   ragged requires the paged engine.
+
+The math is deliberately the engine's proven paged kernels composed
+into one trace: the prefill phase is ``_paged_admit_chunk_impl`` with
+the resident-prefix width pinned to the FULL table (masking, not
+shape, hides the tail — f32 softmax with the -1e30 mask makes wider
+padding bit-neutral) and per-row occupancy masking; the decode phase
+is ``_paged_chunk_impl`` with one step. Sampling keys stay
+``fold_in(key(seed), plen)`` / ``fold_in(key(seed), pos + 1)``, int8
+KV scales ride along unchanged, so greedy outputs are bit-identical to
+the ragged-off engine — the migration gate tests/test_ragged.py pins.
+
+Capacity is NOT padding: a wave's unused token-slots cost the real
+ragged TPU kernel nothing (it walks per-request token counts, the
+whole point), so the sched ledger accounts a wave as
+``useful == packed tokens`` with zero bucket/group pad — see
+docs/benchmarking.md "Ragged dispatch" for the sizing formula and the
+tiny-batch crossover where the dense path still wins.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from seldon_tpu.models import transformer
+from seldon_tpu.models.config import ModelConfig
+from seldon_tpu.models.sampling import sample_per_row
+
+Cache = Dict[str, jnp.ndarray]
+State = Dict[str, Any]
+
+
+def token_buffer_size(max_slots: int, chunk: int) -> int:
+    """The wave's fixed token capacity: ``max_slots * chunk``. Sizing
+    formula (docs/benchmarking.md): chunk bounds per-wave prefill
+    progress per slot, so TTFT under load ~ ceil(prompt / chunk) waves;
+    HBM workspace and host-array traffic scale with the product."""
+    return max_slots * chunk
+
+
+def _mask_state(old: State, new: State, mask: jnp.ndarray) -> State:
+    """Merge per-slot state writes under the occupancy mask: masked-out
+    rows keep every field bit-for-bit (``where`` on the [B] leaves; the
+    KV pool is excluded — its writes are trash-routed by position, not
+    masked here)."""
+    out = dict(old)
+    for key in ("last_tok", "pos", "active", "temp", "top_k", "top_p",
+                "seeds", "remaining"):
+        out[key] = jnp.where(mask, new[key], old[key])
+    out["cache"] = new["cache"]
+    return out
+
+
+def ragged_prefill_phase(
+    params: Any,
+    state: State,
+    table: jnp.ndarray,   # [B, NBs] int32 block tables
+    tokens: jnp.ndarray,  # [B * chunk] flat token buffer
+    plens: jnp.ndarray,   # [B] full prompt lengths
+    starts: jnp.ndarray,  # [B] KV-resident tokens (chunk start)
+    seeds: jnp.ndarray,
+    temps: jnp.ndarray,
+    top_ks: jnp.ndarray,
+    top_ps: jnp.ndarray,
+    max_news: jnp.ndarray,
+    finals: jnp.ndarray,      # [B] bool — last chunk: sample + arm
+    is_prefill: jnp.ndarray,  # [B] bool occupancy mask
+    cfg: ModelConfig,
+) -> Tuple[State, jnp.ndarray, jnp.ndarray]:
+    """The wave's prefill leg: run every occupied segment of the token
+    buffer through prefill_with_prefix against the FULL block-table
+    gather (resident width = the whole window; the t < start mask hides
+    the tail, so one static width serves every mix), scatter fresh KV
+    through the tables, sample first tokens on final rows. Exactly
+    ``_paged_admit_chunk_impl`` with the group axis pinned to all slots
+    and non-prefill rows masked out (their descriptors trash-route the
+    scatter: start = Smax puts every write past the table)."""
+    pool = state["cache"]
+    block = pool["k"].shape[3]
+    nbs = table.shape[1]
+    Smax = nbs * block
+    B = table.shape[0]
+    Sc = tokens.shape[0] // B
+    toks = tokens.reshape(B, Sc)
+    prefix_kv = transformer.paged_prefix_view(pool, table, nbs)
+    logits, kv = transformer.prefill_with_prefix(
+        params, toks, plens, prefix_kv, starts, cfg
+    )
+    keys = jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+    )(seeds, plens)
+    first = sample_per_row(logits, keys, temps, top_ks, top_ps)
+    first_done = (
+        (first == cfg.eos_token_id)
+        | (max_news <= 1)
+        | (plens + 1 >= Smax)
+    )
+    new_pos = jnp.minimum(plens, starts + Sc)
+    if cfg.kv_cache_dtype == "int8":
+        kq, ks = transformer._quantize_kv(kv["k"])
+        vq, vs = transformer._quantize_kv(kv["v"])
+        writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    else:
+        dt = pool["k"].dtype
+        writes = {"k": kv["k"].astype(dt), "v": kv["v"].astype(dt)}
+    spos = starts[:, None] + jnp.arange(Sc)[None, :]
+    new_pool = transformer.paged_scatter_tokens(pool, writes, table,
+                                                spos)
+    new_state = _mask_state(
+        state,
+        {
+            "cache": new_pool,
+            "last_tok": first,
+            "pos": new_pos,
+            "active": finals & ~first_done,
+            "temp": temps,
+            "top_k": top_ks,
+            "top_p": top_ps,
+            "seeds": seeds,
+            "remaining": max_news - 1,
+        },
+        is_prefill,
+    )
+    return new_state, first, first_done
+
+
+def ragged_decode_phase(
+    params: Any,
+    state: State,
+    table: jnp.ndarray,
+    cfg: ModelConfig,
+) -> Tuple[State, jnp.ndarray, jnp.ndarray]:
+    """The wave's decode leg: ONE decode step over every slot, reading
+    and writing KV through the block tables — ``_paged_chunk_impl``
+    with n_steps = 1 (the same lax.scan wrapper, so the primitive
+    sequence — and therefore greedy argmax — matches the ragged-off
+    engine exactly). Rows armed by this wave's prefill leg decode
+    immediately, mirroring the off path where the decode chunk follows
+    the admissions inside one scheduler wave."""
+    block = state["cache"]["k"].shape[3]
+    Smax = table.shape[1] * block
+
+    def step(carry, _):
+        run = carry["active"]
+        logits, pool = transformer.paged_decode_step(
+            params, carry["last_tok"], carry["pos"], carry["cache"],
+            table, cfg,
+        )
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
+        )(carry["seeds"], carry["pos"])
+        tok = sample_per_row(
+            logits,
+            keys,
+            carry["temp"],
+            jnp.where(run, carry["top_k"], 0),
+            jnp.where(run, carry["top_p"], 1.0),
+        )
+        tok = jnp.where(run, tok, cfg.pad_token_id)
+        pos = carry["pos"] + run.astype(jnp.int32)
+        remaining = carry["remaining"] - run.astype(jnp.int32)
+        done = run & (
+            (tok == cfg.eos_token_id)
+            | (remaining <= 0)
+            | (pos >= Smax - 1)
+        )
+        new_carry = {
+            **carry,
+            "cache": pool,
+            "last_tok": jnp.where(run, tok, carry["last_tok"]),
+            "pos": pos,
+            "active": carry["active"] & ~done,
+            "remaining": remaining,
+        }
+        return new_carry, (tok, run)
+
+    state, (toks, valid) = jax.lax.scan(step, state, None, length=1)
+    return state, toks, valid
+
+
+def ragged_wave(
+    params: Any,
+    state: State,
+    table: jnp.ndarray,
+    tokens: jnp.ndarray,
+    plens: jnp.ndarray,
+    starts: jnp.ndarray,
+    seeds: jnp.ndarray,
+    temps: jnp.ndarray,
+    top_ks: jnp.ndarray,
+    top_ps: jnp.ndarray,
+    max_news: jnp.ndarray,
+    finals: jnp.ndarray,
+    is_prefill: jnp.ndarray,
+    cfg: ModelConfig,
+) -> Tuple[State, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One full unified wave: prefill leg then decode leg in a single
+    trace (ONE dispatch, ONE compiled variant). Returns
+    ``(state, first [B], first_done [B], toks [1, B], valid [1, B])``
+    — first/first_done are slot-indexed (the caller reads row
+    ``req.slot``), toks/valid flow through the engine's chunk-boundary
+    processing unchanged."""
+    state, first, first_done = ragged_prefill_phase(
+        params, state, table, tokens, plens, starts, seeds, temps,
+        top_ks, top_ps, max_news, finals, is_prefill, cfg,
+    )
+    state, toks, valid = ragged_decode_phase(params, state, table, cfg)
+    return state, first, first_done, toks, valid

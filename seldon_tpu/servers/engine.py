@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from seldon_tpu.core import tracing
-from seldon_tpu.models import transformer
+from seldon_tpu.models import ragged_attention, transformer
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
 from seldon_tpu.servers import compile_ledger, controller, flight_recorder
@@ -138,6 +138,16 @@ class EngineConfig:
     paged_kv: bool = False
     kv_block: int = 16  # tokens per pool block; power of two
     kv_pool_blocks: int = 0  # pool size incl. trash block; 0 -> dense-equiv
+    # Ragged unified dispatch (opt-in; graftragged): every scheduler wave
+    # runs ONE fused kernel over all slots — mixed cold prefills, chunk
+    # continuations and decode steps in a single compiled variant
+    # (models/ragged_attention.py), collapsing the (bucket, group, width)
+    # jit lattice to key ("ragged", chunk) plus ("deactivate",). Requires
+    # paged_kv + chunked_prefill (block tables are the wave's only KV
+    # currency; the wave IS a chunk boundary). False keeps every dispatch
+    # byte-identical to the bucketed engine.
+    ragged: bool = False
+    ragged_chunk: int = 0  # per-slot tokens per wave; 0 -> prefill_chunk
     # Request-lifecycle hardening (defaults keep the dispatch path
     # byte-identical): TTL applied to requests that set no
     # SamplingParams.deadline_ms of their own, a bound on the admission
@@ -228,6 +238,25 @@ class EngineConfig:
                     f"kv_pool_blocks ({self.kv_pool_blocks}) must be >= 2 "
                     f"(1 reserved trash block + 1 usable) or 0 for the "
                     f"dense-equivalent budget"
+                )
+        if self.ragged:
+            if not (self.paged_kv and self.chunked_prefill):
+                raise ValueError(
+                    "ragged=True requires paged_kv=True and "
+                    "chunked_prefill=True — the unified wave walks block "
+                    "tables and admits prompts chunkwise"
+                )
+            rc = self.ragged_chunk or self.prefill_chunk
+            if not pow2(rc):
+                raise ValueError(
+                    f"ragged_chunk ({rc}) must be a power of two — it is "
+                    f"the ONE compiled wave width"
+                )
+            if rc % self.kv_block:
+                raise ValueError(
+                    f"ragged_chunk ({rc}) must be a multiple of kv_block "
+                    f"({self.kv_block}) so wave boundaries append whole "
+                    f"pool blocks"
                 )
         if self.default_deadline_ms < 0:
             raise ValueError(
@@ -831,6 +860,28 @@ class InferenceEngine:
         self._jit_deactivate = jax.jit(
             self._deactivate_impl, donate_argnums=(0,)
         )
+        # graftragged (opt-in): the unified ragged wave — ONE jit serving
+        # every mix of cold prefills / chunk continuations / decodes over
+        # all B slots (models/ragged_attention.py), so the whole chunk /
+        # bucket / group ladder above never dispatches and warmup
+        # collapses to {("ragged", C), ("deactivate",)}. Requires the
+        # paged + chunked engines (validated in EngineConfig); inherits
+        # their single-process restriction through self._paged.
+        self._ragged = (
+            bool(self.ecfg.ragged) and self._paged and self._chunked
+        )
+        self._jit_ragged = None
+        if self._ragged:
+            self._ragged_chunk = min(
+                self.ecfg.ragged_chunk or self._prefill_chunk,
+                max(self._buckets),
+            )
+            self._jit_ragged = jax.jit(
+                functools.partial(
+                    self._ragged_impl, cfg=self.cfg, mesh=mesh,
+                ),
+                donate_argnums=(1,),
+            )
         # Request-scoped tracing + flight recorder (both env-gated, both
         # zero hot-path cost when off). Lifecycle spans are emitted
         # retroactively at terminal time from _Request timestamps;
@@ -1431,6 +1482,29 @@ class InferenceEngine:
         }
         return {**state, "cache": new_pool}
 
+    @staticmethod
+    def _ragged_impl(
+        params, state, table, tokens, plens, starts, seeds, temps,
+        top_ks, top_ps, max_news, finals, is_prefill, *, cfg, mesh=None,
+    ):
+        """graftragged: the ONE unified wave — every slot's prefill
+        segment of the flat token buffer plus one decode step for every
+        armed row, fused into a single trace
+        (models/ragged_attention.ragged_wave). Descriptors are [B]
+        arrays, the token buffer is [B * ragged_chunk]; nothing about
+        the live mix is a shape, so this compiles exactly once. The
+        wave math IS _paged_admit_chunk_impl + _paged_chunk_impl(1)
+        with masking instead of slot-gather, so greedy outputs stay
+        bit-identical to the bucketed engine (tests/test_ragged.py)."""
+        state, first, first_done, toks, valid = ragged_attention.ragged_wave(
+            params, state, table, tokens, plens, starts, seeds, temps,
+            top_ks, top_ps, max_news, finals, is_prefill, cfg,
+        )
+        first, first_done, toks, valid, active = InferenceEngine._replicate(
+            mesh, first, first_done, toks, valid, state["active"]
+        )
+        return state, first, first_done, toks, valid, active
+
     # --- public API ---------------------------------------------------------
 
     def submit(
@@ -1847,6 +1921,8 @@ class InferenceEngine:
             token_budget=(
                 self.ecfg.dispatch_token_budget or self._prefill_chunk
             ) if chunked else 0,
+            ragged=self._ragged,
+            ragged_chunk=self._ragged_chunk if self._ragged else 0,
         )
 
     def static_lattice(self) -> List[str]:
@@ -1967,6 +2043,27 @@ class InferenceEngine:
                 jnp.ones((G,), jnp.int32),
                 jnp.arange(G, dtype=jnp.int32),
                 prefix_width=W,
+            )
+        elif kind == "ragged" and self._ragged:
+            # The ONE wave: all-trash tables (starts = Smax routes every
+            # scatter past the table) and an all-False occupancy mask
+            # keep the compile a pure no-op over real state.
+            _, C = key
+            B = self.ecfg.max_slots
+            self._state, _, _, _, _, _ = self._jit_ragged(
+                self.params,
+                self._state,
+                jnp.zeros((B, self._nbs), jnp.int32),
+                jnp.zeros((B * C,), jnp.int32),
+                jnp.ones((B,), jnp.int32),
+                jnp.full((B,), Smax, jnp.int32),
+                jnp.zeros((B,), jnp.uint32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.int32),
+                jnp.zeros((B,), jnp.bool_),
+                jnp.zeros((B,), jnp.bool_),
             )
         elif kind == "chunk" and self._chunked:
             _, Sc, G, W = key
@@ -3045,6 +3142,246 @@ class InferenceEngine:
                         self.stats.sched_frag_tokens += left
         return admits
 
+    # --- ragged unified dispatch (graftragged) ------------------------------
+
+    def _collect_ragged_work(  # graftlint: holds(_book)
+        self, left: int
+    ) -> List[Tuple[_Request, int, bool]]:
+        """One wave's prefill packing: each dispatchable request claims
+        its slot's fixed [ragged_chunk] segment of the token buffer, with
+        EXACTLY its real token count — no bucket rounding, no pow2 group
+        replication, so the ledger's padding attribution for a wave is
+        zero by construction. Continuing prefills go first (same
+        round-robin deque as the bucketed path), new admissions gate on
+        a free slot + first-chunk pool reservation BEFORE the slot pop.
+        Returns (req, chunk_len, final) rows; a request appears at most
+        once (one segment per slot per wave)."""
+        C = self._ragged_chunk
+        work: List[Tuple[_Request, int, bool]] = []
+        while left > 0:
+            if self._prefilling:
+                req = self._prefilling.popleft()
+                if req.finished:  # failed by an earlier error path
+                    continue
+            elif self._waiting and self._free:
+                if self._pilot is not None and self._shed_expired_head():
+                    continue  # expired head must not claim a slot
+                req = self._waiting[0]
+                rem = len(req.tokens)
+                est = min(C, rem)
+                if est > left:
+                    break
+                if self._paged and not self._pool_reserve(
+                    min(est, rem) // self._kv_block + 2
+                ):
+                    # First chunk's blocks (+ a possible CoW tail) must
+                    # fit before the slot pop — admissions stall on pool
+                    # exhaustion rather than half-admit.
+                    with self.stats.lock:
+                        self.stats.pool_stalls += 1
+                    if self._recorder is not None:
+                        self._recorder.record(
+                            "pool-stall", req.rid,
+                            {"waiting": len(self._waiting)},
+                        )
+                    if self._sled is not None:
+                        self._sled.note_pool_stall(req.rid)
+                    break
+                self._waiting.popleft()
+                self._admit_chunk_slot(req)
+            else:
+                break
+            rem = len(req.tokens) - req.prefill_done
+            final = rem <= C
+            clen = rem if final else C
+            if clen > left:
+                # Keeps FIFO priority for the next wave's budget.
+                self._prefilling.appendleft(req)
+                break
+            work.append((req, clen, final))
+            left -= clen
+        return work
+
+    def _dispatch_ragged(self):  # graftlint: holds(_book)
+        """One unified ragged wave (the whole scheduler step under
+        RAGGED=1): pack any mix of cold admissions / chunk continuations
+        into the flat token buffer, then dispatch ONE fused kernel that
+        prefills every packed segment AND runs one decode step for every
+        armed row — no admission groups, no bucket choice, no separate
+        decode dispatch, so the only live variant is ("ragged", C).
+        Returns the same (admits, chunk_handles, roster, timing)
+        boundary tuple as the bucketed path (or None when idle), so
+        boundary fetching/processing is shared unchanged."""
+        self._drain_pending()
+        B = self.ecfg.max_slots
+        C = self._ragged_chunk
+        if self._pilot is not None:
+            budget = self._pilot.dispatch_budget()
+        else:
+            budget = self.ecfg.dispatch_token_budget or B * C
+        work = self._collect_ragged_work(budget)
+        if not work and not self._active_host.any():
+            return None
+        self._chaos_dispatch("ragged")
+        Smax = self.ecfg.max_seq_len
+        toks = np.full((B, C), self.cfg.pad_token_id, np.int32)
+        plens = np.ones((B,), np.int32)
+        # Idle rows' descriptors trash-route every KV write: start =
+        # Smax puts the whole segment past the table (the paged pool's
+        # write-before-read discipline, reused as the occupancy mask's
+        # device-side half).
+        starts = np.full((B,), Smax, np.int32)
+        seeds = np.zeros((B,), np.uint32)
+        temps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        max_news = np.ones((B,), np.int32)
+        finals = np.zeros((B,), bool)
+        is_prefill = np.zeros((B,), bool)
+        packed = 0
+        for req, clen, final in work:
+            s = req.slot
+            sp = req.params
+            start = req.prefill_done
+            toks[s, :clen] = req.tokens[start:start + clen]
+            plens[s] = len(req.tokens)
+            starts[s] = start
+            seeds[s] = np.uint32(int(sp.seed) & 0xFFFFFFFF)
+            temps[s] = sp.temperature
+            top_ks[s] = sp.top_k
+            top_ps[s] = sp.top_p
+            max_news[s] = sp.max_new_tokens
+            finals[s] = final
+            is_prefill[s] = True
+            packed += clen
+        # Append each packed row's pool blocks (trie eviction, then
+        # preemption of younger streams, backstop the allocation — real
+        # KV must never scatter into the trash block).
+        bs = self._kv_block
+        for req, clen, _ in work:
+            need = min(self._nbs, -(-(req.prefill_done + clen) // bs))
+            have = len(req.block_ids)
+            if need > have:
+                got = self._secure_blocks(need - have, requester=req)
+                if got is None:
+                    raise RuntimeError(
+                        "kv cache pool exhausted (ragged wave)"
+                    )
+                for j, bid in enumerate(got):
+                    self._table_host[req.slot, have + j] = bid
+                req.block_ids.extend(got)
+        # Post-prefill bookkeeping BEFORE the roster/growth pass: final
+        # rows flip to decoding so this wave's decode leg covers them
+        # (their table rows grow to the first-token position), exactly
+        # like the off path where the decode chunk follows the final
+        # admission chunk inside one scheduler step.
+        group: List[_Request] = []
+        finals_l: List[bool] = []
+        for req, clen, final in work:
+            if req.finished:
+                # Preempted by a later row's block grab: its table row
+                # is zeroed (KV scatters to trash) — also drop its state
+                # writes so the freed slot stays inert.
+                finals[req.slot] = False
+                is_prefill[req.slot] = False
+                continue
+            req.prefill_done += clen
+            group.append(req)
+            finals_l.append(final)
+            if final:
+                req.prefilling = False
+                req.expected = 1  # the wave samples the first token
+            else:
+                self._prefilling.append(req)
+            if self._paged_prefix is not None:
+                self._insert_paged_prompt(req, upto=req.prefill_done)
+        self._record_first_dispatch(group)
+        roster = self._roster()
+        self._dispatch_wreck = ([], None, roster, None)
+        self._grow_decode_blocks(1)
+        if self._observe:
+            t0 = time.perf_counter()
+        out = self._jit_ragged(
+            self.params,
+            self._state,
+            jnp.asarray(self._table_host),
+            jnp.asarray(toks.reshape(-1)),
+            jnp.asarray(plens),
+            jnp.asarray(starts),
+            jnp.asarray(seeds),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            jnp.asarray(max_news),
+            jnp.asarray(finals),
+            jnp.asarray(is_prefill),
+        )
+        self._state, first, first_done, toks_d, valid_d, active_d = out
+        if self._observe:
+            self._note_dispatch(
+                ("ragged", C), group[0].rid if group else -1,
+                time.perf_counter() - t0,
+            )
+        if self._hbm is not None:
+            self._hbm.note_workspace(
+                int(toks.nbytes) + B * self.cfg.vocab_size * 4
+            )
+        admits = [(group, finals_l, first, first_done)] if group else []
+        self._dispatch_wreck = (admits, None, roster, None)
+        with self.stats.lock:
+            self.stats.decode_dispatches += 1
+            self.stats.decode_steps += 1
+            if group:
+                self.stats.prefill_chunks += len(group)
+                self.stats.prefill_chunk_tokens += packed
+                self.stats.budget_dispatches += 1
+                self.stats.budget_tokens += packed
+                self.stats.budget_limit = budget
+        self._recycle_budget_spent(roster, 1)
+        for h in (first, first_done, toks_d, valid_d, active_d):
+            h.copy_to_host_async()
+        wf = 0.0
+        if self._sled is not None:
+            # A wave's unused token-slots are NOT padding: the ragged
+            # kernel walks per-request token counts, so cost scales with
+            # packed tokens, not capacity (docs/benchmarking.md "Ragged
+            # dispatch") — cells == useful, zero bucket/group pad.
+            if packed:
+                self._sled.note_group(("ragged", C), packed, packed, 0, 0)
+                with self.stats.lock:
+                    self.stats.sched_useful_tokens += packed
+                starved = bool(
+                    self._prefilling or (self._waiting and self._free)
+                )
+                self._sled.note_budget(budget, packed, starved)
+                if starved and budget > packed:
+                    with self.stats.lock:
+                        self.stats.sched_frag_tokens += budget - packed
+            self._sled.note_boundary()
+            wf = self._sled.boundary_waste()
+            with self.stats.lock:
+                self.stats.record_waste_locked(wf)
+        if self._pilot is not None:
+            self._pilot_tick()
+        if self._recorder is not None:
+            detail = {
+                "admits": len(group),
+                "chunk": 1,
+                "active": int(self._active_host.sum()),
+                "packed_tokens": packed,
+                "pool_free": int(self._allocator.free_count),
+            }
+            if self._sled is not None:
+                detail["waste_frac"] = round(wf, 4)
+            self._recorder.record("boundary", -1, detail)
+        if self._timing_on:
+            timing = (time.perf_counter(), self._wave_keys)
+            self._wave_keys = []
+        else:
+            timing = None
+        self._dispatch_wreck = None
+        return (admits, (toks_d, valid_d, active_d), roster, timing)
+
     # --- boundary processing -----------------------------------------------
 
     def _process_admits(  # graftlint: holds(_book)
@@ -3070,14 +3407,17 @@ class InferenceEngine:
                 if req.finished:  # already failed by an error path
                     continue
                 slot = req.slot
-                first_tok = int(first_h[i])
+                # Ragged waves return [B] slot-indexed rows (the whole
+                # batch IS the group); bucketed groups are group-indexed.
+                idx = slot if self._ragged else i
+                first_tok = int(first_h[idx])
                 req.first_token_at = now
                 req.last_burst_at = now
                 ttft_ms = 1000.0 * (now - req.submitted_at)
                 ttft_total += ttft_ms
                 req.n_generated = 1
                 req.out.put({"tokens": [first_tok], "ttft_ms": ttft_ms})
-                if bool(done_h[i]):
+                if bool(done_h[idx]):
                     self._complete(req)
                 elif self._slots[slot] is req:
                     # Not armed when the slot was already optimistically
@@ -3669,6 +4009,10 @@ class InferenceEngine:
         the error path can fail recycled-out-of-_slots requests."""
         self._dispatch_wreck = None
         self._reap_lifecycle()
+        if self._ragged:
+            # graftragged: the whole step is ONE fused wave — no
+            # separate admission groups or decode chunk below.
+            return self._dispatch_ragged()
         admits = (
             self._dispatch_prefill_chunks() if self._chunked
             else self._dispatch_admits()
@@ -3756,6 +4100,9 @@ class InferenceEngine:
         # Slot/free-list/active bookkeeping runs under _book even in the
         # synchronous (no fetcher thread) mode: drain(), cancel paths and
         # debug_lifecycle_check() read the same state from other threads.
+        if self._ragged:
+            self._loop_sync_ragged()
+            return
         pending: Optional[Tuple[list, Any, list, Any]] = None
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path
@@ -3840,6 +4187,53 @@ class InferenceEngine:
                     self._fail_all(
                         str(e), [pending, (admits, None, roster, None)]
                     )
+                pending = None
+        # Drain the in-flight boundary so stop() doesn't strand requests.
+        if pending is not None:
+            try:
+                with self._book:
+                    self._process_boundary(*pending)
+            except Exception as e:
+                logger.exception("final boundary failed")
+                with self._book:
+                    self._fail_all(str(e), [pending])
+
+    def _loop_sync_ragged(self) -> None:
+        """Synchronous scheduler loop under RAGGED=1: each iteration is
+        ONE fused wave (_dispatch_once routes to _dispatch_ragged),
+        software-pipelined one boundary deep exactly like the bucketed
+        loop — wave N+1 dispatches before wave N's results are
+        fetched. Requests optimistically recycled out of _slots live in
+        `pending` rosters and the dispatch wreck, so the error path
+        fails both."""
+        pending: Optional[Tuple[list, Any, list, Any]] = None
+        while not self._stop.is_set():
+            try:
+                with self._book:
+                    work = self._dispatch_once()
+                    if pending is not None:
+                        self._process_boundary(*pending)
+                    pending = work
+                    idle = (
+                        pending is None and not self._active_host.any()
+                    )
+                if self._profile_n and pending is not None:
+                    self._profile_tick()
+                # Sleep outside the lock so drain()/cancel() never wait
+                # on an idle tick.
+                if idle and self._pending.empty():
+                    if self._sled is not None:
+                        self._sled.note_idle()
+                        with self.stats.lock:
+                            self.stats.sched_idle_boundaries += 1
+                    time.sleep(self.ecfg.idle_sleep_s)
+            except Exception as e:  # fail requests, reset, keep serving
+                logger.exception("engine iteration failed")
+                with self._book:
+                    wreck, self._dispatch_wreck = (
+                        self._dispatch_wreck, None
+                    )
+                    self._fail_all(str(e), [pending, wreck])
                 pending = None
         # Drain the in-flight boundary so stop() doesn't strand requests.
         if pending is not None:

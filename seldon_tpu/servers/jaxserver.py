@@ -64,6 +64,8 @@ class JAXServer(SeldonComponent):
         paged_kv: int = -1,
         kv_block: int = 0,
         kv_pool_mb: int = 0,
+        ragged: int = -1,
+        ragged_chunk: int = 0,
         max_queue: int = 0,
         default_deadline_ms: int = 0,
     ):
@@ -128,6 +130,20 @@ class JAXServer(SeldonComponent):
         self.kv_pool_mb = int(
             kv_pool_mb or _os.environ.get("KV_POOL_MB", "0") or 0
         )
+        # graftragged unified dispatch (servers/engine.py _dispatch_ragged
+        # + models/ragged_attention.py): unit parameter, or RAGGED=1 /
+        # RAGGED_CHUNK env. Implies paged_kv + chunked_prefill (the wave
+        # needs block tables and chunkwise admission), so RAGGED=1 alone
+        # is a complete switch. -1 / 0 = follow the env (default off).
+        if int(ragged) < 0:
+            ragged = int(_os.environ.get("RAGGED", "0") or 0)
+        self.ragged = bool(int(ragged))
+        self.ragged_chunk = int(
+            ragged_chunk or _os.environ.get("RAGGED_CHUNK", "0") or 0
+        )
+        if self.ragged:
+            self.paged_kv = True
+            self.chunked_prefill = True
         # Request-lifecycle hardening (servers/engine.py): bounded
         # admission queue (submit sheds with 429 EngineOverloaded past
         # this depth; 0 = unbounded) and a default per-request TTL in ms
@@ -280,6 +296,10 @@ class JAXServer(SeldonComponent):
                     )
                     blocks = (self.kv_pool_mb << 20) // (per_tok * kb)
                     ekw["kv_pool_blocks"] = max(2, int(blocks))
+            if self.ragged:
+                ekw["ragged"] = True
+                if self.ragged_chunk:
+                    ekw["ragged_chunk"] = self.ragged_chunk
             if self.max_queue:
                 ekw["max_queue"] = self.max_queue
             if self.default_deadline_ms:

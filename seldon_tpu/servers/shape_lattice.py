@@ -70,6 +70,7 @@ FAMILIES = {
     "seed-prefix": 2,    # (tag, prefix width)
     "cow": 1,            # copy-on-write block copy (traced scalars)
     "decode": 2,         # (tag, chunk-ladder rung)
+    "ragged": 2,         # (tag, per-slot chunk capacity) — the ONE wave
 }
 
 
@@ -92,6 +93,12 @@ class LatticeSpec:
     chunk_buckets: Tuple[int, ...] = ()   # engine _chunk_buckets
     prefill_chunk: int = 0          # engine _prefill_chunk (clamped C)
     token_budget: int = 0           # dispatch_token_budget or C
+    # graftragged (models/ragged_attention.py): every scheduler wave is
+    # ONE fused kernel of fixed shape [max_slots, ragged_chunk] —
+    # bucketing, pow2 grouping, decode rungs and the whole admit/chunk
+    # key space collapse to the single ("ragged", C) variant.
+    ragged: bool = False
+    ragged_chunk: int = 0           # engine _ragged_chunk (per-slot C)
 
     def __post_init__(self):
         if not self.buckets:
@@ -105,6 +112,12 @@ class LatticeSpec:
                 "chunked spec needs chunk_buckets, prefill_chunk and a "
                 "token_budget >= prefill_chunk (EngineConfig validates "
                 "the same)"
+            )
+        if self.ragged and (not self.paged or not self.chunked
+                            or self.ragged_chunk <= 0):
+            raise ValueError(
+                "ragged spec needs paged + chunked engines and a "
+                "positive ragged_chunk (EngineConfig validates the same)"
             )
 
 
@@ -185,6 +198,15 @@ def dispatch_keys(spec: LatticeSpec) -> Set[Key]:
     """The closed-form lattice: every static-shape key live scheduling
     can dispatch under `spec`.  warmup() compiles exactly this set."""
     maxp = max(spec.buckets)
+    if spec.ragged:
+        # graftragged: the whole admit/chunk/decode key space is ONE
+        # fixed-shape wave — the lattice is the lifecycle freeze plus
+        # the wave itself (plus the traced-scalar CoW copy when the
+        # paged prefix trie can share a partially-filled block).
+        keys = {("deactivate",), ("ragged", spec.ragged_chunk)}
+        if spec.prefix:
+            keys.add(("cow",))
+        return keys
     keys: Set[Key] = {("deactivate",)}
     keys |= {("decode", n) for n in spec.decode_rungs}
     if spec.paged and spec.prefix:
@@ -259,6 +281,22 @@ def simulate_keys(spec: LatticeSpec) -> Set[Key]:
     the certifier's grid check is the two derivations agreeing."""
     maxp = max(spec.buckets)
     smax = spec.max_seq_len
+    if spec.ragged:
+        # Scenario walk: every prompt, at every prefix-match offset,
+        # prefills in ceil(rem / C) waves and decodes one step per
+        # wave — and EVERY one of those dispatches is the same fixed
+        # [max_slots, ragged_chunk] kernel. Only warm partial-block
+        # tails add the CoW copy.
+        keys = {("deactivate",)}
+        if spec.prefix:
+            keys.add(("cow",))
+        for plen in range(1, maxp + 1):
+            start = 0
+            while start < plen:
+                keys.add(("ragged", spec.ragged_chunk))  # prefill wave
+                start += spec.ragged_chunk
+            keys.add(("ragged", spec.ragged_chunk))      # decode wave
+        return keys
     keys: Set[Key] = {("deactivate",)}
     keys |= {("decode", n) for n in spec.decode_rungs}
     if spec.paged and spec.prefix:
@@ -320,7 +358,7 @@ def simulate_keys(spec: LatticeSpec) -> Set[Key]:
 # sequence), numeric components ascending within a family.
 _FAMILY_RANK = {
     "deactivate": 0, "admit": 1, "admit-prefix": 2, "admit-paged": 3,
-    "seed-prefix": 4, "chunk": 5, "cow": 6, "decode": 7,
+    "seed-prefix": 4, "chunk": 5, "cow": 6, "decode": 7, "ragged": 8,
 }
 
 
@@ -352,6 +390,19 @@ def grid() -> List[LatticeSpec]:
                                            | {c})) if chunked else (),
                 prefill_chunk=c if chunked else 0,
                 token_budget=budget if chunked else 0,
+            ))
+    # graftragged collapse: same shapes, paged+chunked forced (the
+    # ragged wave's preconditions), with and without the prefix trie.
+    for prefix in (False, True):
+        for buckets, smax, slots, ma, c, budget in shapes:
+            specs.append(LatticeSpec(
+                buckets=buckets, max_seq_len=smax, max_slots=slots,
+                max_admit=ma, decode_rungs=(4, 8), paged=True,
+                chunked=True, prefix=prefix, prefix_block=16,
+                chunk_buckets=tuple(sorted({min(b, c) for b in buckets}
+                                           | {c})),
+                prefill_chunk=c, token_budget=budget,
+                ragged=True, ragged_chunk=c,
             ))
     return specs
 

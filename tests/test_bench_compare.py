@@ -94,7 +94,9 @@ def test_direction_heuristic():
     assert d("detail.bench_1b.vs_baseline") == "higher"
     assert d("detail.live_retraces") == "strict"
     assert d("detail.total_tokens") == "info"
-    assert d("detail.compile_variants") == "info"
+    # Exact variant counts gate strictly: the static lattice is closed
+    # form, so any growth is a real regression, not noise.
+    assert d("detail.compile_variants") == "strict"
 
 
 # ---------------------------------------------------------------------------

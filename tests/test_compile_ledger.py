@@ -247,6 +247,10 @@ def test_trace_view_variant_lanes_and_retrace_instants():
     rec.record("dispatch", -1, {"variant": "admit/32/4", "ms": 812.0})
     rec.record("dispatch", -1, {"variant": "decode/8", "ms": 2.5})
     rec.record("dispatch", -1, {"variant": "decode/8", "ms": 2.4})
+    # The graftragged wave key uses the same stable slash rendering —
+    # repeated waves share ONE lane named "ragged/8".
+    rec.record("dispatch", -1, {"variant": "ragged/8", "ms": 3.0})
+    rec.record("dispatch", -1, {"variant": "ragged/8", "ms": 2.9})
     rec.record("terminal", 1, {"outcome": "ok"})
 
     out = json.loads(json.dumps(trace_view.convert(rec.snapshot())))
@@ -257,12 +261,12 @@ def test_trace_view_variant_lanes_and_retrace_instants():
 
     lanes = [e for e in events if e.get("pid") == trace_view._VARIANT_PID]
     slices = [e for e in lanes if e["ph"] == "X"]
-    assert len(slices) == 3
+    assert len(slices) == 5
     # One lane (tid) per variant key, stable across repeats.
     by_name = {}
     for e in slices:
         by_name.setdefault(e["name"], set()).add(e["tid"])
-    assert set(by_name) == {"admit/32/4", "decode/8"}
+    assert set(by_name) == {"admit/32/4", "decode/8", "ragged/8"}
     assert all(len(tids) == 1 for tids in by_name.values())
     # Slices back-span from the sync point with the recorded duration.
     admit = next(e for e in slices if e["name"] == "admit/32/4")
@@ -271,7 +275,7 @@ def test_trace_view_variant_lanes_and_retrace_instants():
     metas = [e for e in lanes if e["ph"] == "M"]
     assert {"seldon-tpu variants"} == {
         e["args"]["name"] for e in metas if e["name"] == "process_name"}
-    assert {"admit/32/4", "decode/8"} == {
+    assert {"admit/32/4", "decode/8", "ragged/8"} == {
         e["args"]["name"] for e in metas if e["name"] == "thread_name"}
 
 

@@ -70,7 +70,8 @@ def rules(findings):
 
 def test_grid_closed_form_matches_simulation():
     specs = shape_lattice.grid()
-    assert len(specs) == 32  # 8 flag combos x 4 bucket shapes
+    # 8 flag combos x 4 bucket shapes + 2 ragged combos x 4 shapes
+    assert len(specs) == 40
     for spec in specs:
         holes, waste = shape_lattice.check_spec(spec)
         assert holes == [], (spec, holes)

@@ -19,9 +19,11 @@ and compared base -> candidate with a direction heuristic:
  * higher-is-better: names containing ``req_per_s``, ``req_s``,
    ``tokens_per_s``, ``speedup``, ``hit_rate``, ``goodput``,
    ``coverage``, plus the headline ``value`` / ``vs_baseline``;
- * strict:           ``live_retraces`` — any increase over base fails
-   regardless of tolerance (a retrace storm is a correctness-of-the-
-   lattice bug, not noise);
+ * strict:           ``live_retraces`` and ``compile_variants`` — any
+   increase over base fails regardless of tolerance (a retrace storm
+   is a correctness-of-the-lattice bug, and the variant count is an
+   exact closed-form property of the config — graftragged collapses
+   it to ≤ 2, so even one stray variant is a real regression);
  * everything else is informational (printed, never gated).
 
 A gated metric regresses when it moves the wrong way by more than the
@@ -54,7 +56,7 @@ _HIGHER_EXACT = ("value", "vs_baseline")
 # "goodput_gap" would otherwise match the higher-is-better "goodput"
 # substring, and "padding_waste_frac" matches nothing ("frac" != "frag").
 _LOWER_EXACT = ("padding_waste_frac", "goodput_gap")
-_STRICT = ("live_retraces",)
+_STRICT = ("live_retraces", "compile_variants")
 
 
 def load_metric(path: str) -> Dict[str, Any]:

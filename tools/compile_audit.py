@@ -26,6 +26,14 @@ actually dispatched must be a member of ``engine.static_lattice()``
 variant count must equal the static lattice size — i.e. warmup
 declared exactly the statically-certified set, nothing ad hoc.
 
+The audit then runs a second, RAGGED leg: the same warmed tiny server
+under ``RAGGED=1`` driven by the same loadtester mix, asserting the
+graftragged collapse — compile-variant count ≤ ``RAGGED_VARIANT_BUDGET``
+(deactivate + the one ``ragged/C`` wave kernel) and zero live
+retraces. The ragged numbers ride the metric line
+(``ragged_compile_variants`` / ``ragged_live_retraces``) so
+``bench_compare`` gates them strictly.
+
 Run via ``make compile-audit`` (wired into ``make ci``); exits non-zero
 with a one-line diagnosis on the first failed check.
 """
@@ -44,6 +52,11 @@ import sys
 # Roadmap items 1-2 drive this DOWN; raising it needs a written
 # justification in the PR that does so.
 VARIANT_BUDGET = 32
+
+# The graftragged contract is exact, not a ceiling with headroom: one
+# unified wave kernel + deactivate. A third variant means the collapse
+# broke (ISSUE 12 acceptance: static_lattice() size ≤ 2 under RAGGED=1).
+RAGGED_VARIANT_BUDGET = 2
 
 
 def _check(cond: bool, msg: str) -> None:
@@ -78,55 +91,65 @@ def main(argv=None) -> int:
     from seldon_tpu.servers.jaxserver import JAXServer
     from tools import trace_view
 
-    # warmup=1 is the point: the audit asserts the declared lattice
-    # covers live traffic, so warmup must actually run.
-    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=64, warmup=1)
-    srv.load()
+    def _drive(**srv_kwargs):
+        """Boot a warmed tiny server behind the REST app, run the
+        short closed-loop loadtester mix, return (srv, loadtester
+        ledger detail, /debug/compile, /debug/hbm, /debug/timeline)."""
+        # warmup=1 is the point: the audit asserts the declared lattice
+        # covers live traffic, so warmup must actually run.
+        srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=64,
+                        warmup=1, **srv_kwargs)
+        srv.load()
 
-    holder, started = {}, threading.Event()
+        holder, started = {}, threading.Event()
 
-    async def amain() -> None:
-        runner = web.AppRunner(build_rest_app(srv))
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        await site.start()
-        holder["port"] = site._server.sockets[0].getsockname()[1]
-        started.set()
-        while not holder.get("stop"):
-            await asyncio.sleep(0.05)
-        await runner.cleanup()
+        async def amain() -> None:
+            runner = web.AppRunner(build_rest_app(srv))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+            started.set()
+            while not holder.get("stop"):
+                await asyncio.sleep(0.05)
+            await runner.cleanup()
 
-    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
-    t.start()
-    _check(started.wait(60), "REST app failed to start within 60s")
-    url = f"http://127.0.0.1:{holder['port']}"
+        t = threading.Thread(target=lambda: asyncio.run(amain()),
+                             daemon=True)
+        t.start()
+        _check(started.wait(60), "REST app failed to start within 60s")
+        url = f"http://127.0.0.1:{holder['port']}"
 
-    try:
-        buf = io.StringIO()
-        with contextlib.redirect_stdout(buf):
-            lt_main([
-                url, "--transport", "generate", "--clients", "2",
-                "--seconds", "2", "--prompt", "hi",
-                "--max-new-tokens", "4",
-            ])
-        ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
-        detail = ledger["detail"]
-        _check(detail["errors"] == 0,
-               f"loadtester saw {detail['errors']} transport errors")
-        _check(detail["requests"] >= 1, "loadtester completed no requests")
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                lt_main([
+                    url, "--transport", "generate", "--clients", "2",
+                    "--seconds", "2", "--prompt", "hi",
+                    "--max-new-tokens", "4",
+                ])
+            ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
+            detail = ledger["detail"]
+            _check(detail["errors"] == 0,
+                   f"loadtester saw {detail['errors']} transport errors")
+            _check(detail["requests"] >= 1,
+                   "loadtester completed no requests")
 
-        with urllib.request.urlopen(f"{url}/debug/compile",
-                                    timeout=10) as resp:
-            comp = json.loads(resp.read())
-        with urllib.request.urlopen(f"{url}/debug/hbm",
-                                    timeout=10) as resp:
-            hbm = json.loads(resp.read())
-        with urllib.request.urlopen(f"{url}/debug/timeline",
-                                    timeout=10) as resp:
-            snap = json.loads(resp.read())
-    finally:
-        holder["stop"] = True
-        t.join(timeout=10)
+            with urllib.request.urlopen(f"{url}/debug/compile",
+                                        timeout=10) as resp:
+                comp = json.loads(resp.read())
+            with urllib.request.urlopen(f"{url}/debug/hbm",
+                                        timeout=10) as resp:
+                hbm = json.loads(resp.read())
+            with urllib.request.urlopen(f"{url}/debug/timeline",
+                                        timeout=10) as resp:
+                snap = json.loads(resp.read())
+        finally:
+            holder["stop"] = True
+            t.join(timeout=10)
+        return srv, detail, comp, hbm, snap
+
+    srv, detail, comp, hbm, snap = _drive()
 
     # --- /debug/compile: schema + the zero-retrace gate -----------------
     for key in ("warmup_complete", "declared_variants",
@@ -208,6 +231,57 @@ def main(argv=None) -> int:
 
     srv.engine.stop()
 
+    # --- RAGGED leg: the graftragged collapse, witnessed live -----------
+    rsrv, rdetail, rcomp, _, _ = _drive(ragged=1)
+    _check(rcomp["warmup_complete"],
+           "ragged: warmup never sealed the lattice")
+    _check(
+        rcomp["live_retrace_count"] == 0,
+        f"ragged: {rcomp['live_retrace_count']} live retraces after "
+        f"warmup: {rcomp['live_retraces']}",
+    )
+    _check(
+        1 <= rcomp["dispatched_variants"] <= RAGGED_VARIANT_BUDGET,
+        f"ragged: {rcomp['dispatched_variants']} variants dispatched — "
+        f"the collapse contract is ≤ {RAGGED_VARIANT_BUDGET} "
+        f"(deactivate + one ragged/C wave kernel)",
+    )
+    rogue = [e["key"] for e in rcomp["lattice"] if not e["declared"]]
+    _check(not rogue, f"ragged: undeclared lattice keys: {rogue}")
+    _check(
+        any(e["key"].startswith("ragged/") for e in rcomp["lattice"]),
+        f"ragged: no ragged/C variant dispatched "
+        f"(got: {sorted(e['key'] for e in rcomp['lattice'])})",
+    )
+    _check(
+        rdetail.get("compile_variants") == rcomp["dispatched_variants"],
+        f"ragged: ledger compile_variants "
+        f"{rdetail.get('compile_variants')} != /debug/compile "
+        f"{rcomp['dispatched_variants']}",
+    )
+    ragged_static_size = None
+    if args.static_xcheck:
+        rstatic = set(rsrv.engine.static_lattice())
+        ragged_static_size = len(rstatic)
+        _check(
+            ragged_static_size <= RAGGED_VARIANT_BUDGET,
+            f"ragged: static lattice holds {ragged_static_size} keys "
+            f"({sorted(rstatic)}) — the closed-form collapse broke",
+        )
+        rdispatched = {e["key"] for e in rcomp["lattice"]}
+        rrogue = sorted(rdispatched - rstatic)
+        _check(
+            not rrogue,
+            f"ragged: runtime dispatched {len(rrogue)} key(s) outside "
+            f"the static lattice: {rrogue}",
+        )
+        _check(
+            rcomp["declared_variants"] == ragged_static_size,
+            f"ragged: warmup declared {rcomp['declared_variants']} "
+            f"variants but the static lattice holds {ragged_static_size}",
+        )
+    rsrv.engine.stop()
+
     print(json.dumps({
         "metric": "compile_audit",
         "value": 1,
@@ -222,6 +296,11 @@ def main(argv=None) -> int:
             "variant_lanes": sorted(lanes),
             "hbm_total_bytes": hbm["total_bytes"],
             "static_lattice": static_size,
+            "ragged_requests": rdetail["requests"],
+            "ragged_compile_variants": rcomp["dispatched_variants"],
+            "ragged_variant_budget": RAGGED_VARIANT_BUDGET,
+            "ragged_live_retraces": rcomp["live_retrace_count"],
+            "ragged_static_lattice": ragged_static_size,
         },
     }))
     return 0

@@ -49,6 +49,17 @@ KNOBS = {
                    "KV block size in tokens (paged mode)."),
     "KV_POOL_MB": _k("engine-serving", "0 (dense-equivalent)",
                      "KV pool size in HBM MiB (paged mode)."),
+    "RAGGED": _k("engine-serving", "0",
+                 "graftragged unified dispatch: pack any mix of prefill "
+                 "chunks, continuations and decode steps into ONE "
+                 "ragged wave kernel (single compiled variant, no "
+                 "bucket/group lattice). Forces paged_kv + "
+                 "chunked_prefill."),
+    "RAGGED_CHUNK": _k("engine-serving", "0 (prefill_chunk)",
+                       "Per-slot token segment per ragged wave; the "
+                       "wave's flat token buffer is max_slots * "
+                       "ragged_chunk. Power of two, multiple of "
+                       "kv_block."),
     "MAX_QUEUE": _k("engine-serving", "0 (unbounded)",
                     "Admission queue bound; past it submit() sheds with "
                     "a retriable 429 EngineOverloaded."),
@@ -298,6 +309,12 @@ KNOBS = {
                                   "compares against."),
     "BENCH_PAGED_KV_BLOCK": _k("bench-harness", "16",
                                "Paged phase KV block size."),
+    "BENCH_RAGGED": _k("bench-harness", "0",
+                       "Run the ragged-dispatch phase: the same closed "
+                       "wave RAGGED=1 vs bucketed at equal hardware, "
+                       "reporting req/s, padding_waste_frac, compile "
+                       "variant count, and the measured speedup vs the "
+                       "waste_roofline prediction."),
     "BENCH_SLO": _k("bench-harness", "1 for bench-1b, else 0",
                     "Run the TTFT SLO search phase."),
     "BENCH_SLO_CHUNK": _k("bench-harness", "0 (adaptive)",
