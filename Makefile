@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -110,6 +110,17 @@ sched-audit:
 pilot-audit:
 	env JAX_PLATFORMS=cpu python -m tools.pilot_audit
 
+# Speculative-decoding gate (docs/benchmarking.md "Speculative
+# decoding"): the tiny server booted twice — plain, then SPEC=1 behind
+# the real REST app under a loadtester window with GRAFTSAN +
+# SCHED_LEDGER + COMPILE_LEDGER on — asserts bit-exact greedy parity,
+# zero live retraces with the verify ladder inside the static lattice,
+# the acceptance identity (accepted + rejected == drafted) and four-way
+# conservation, loadtester/route parity, the jaxserver_spec_* gauges,
+# and the trace_view verify lanes + acceptance counter.
+spec-audit:
+	env JAX_PLATFORMS=cpu python -m tools.spec_audit
+
 bench:
 	python bench.py
 
@@ -121,7 +132,7 @@ bench-compare:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit
+ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

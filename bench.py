@@ -104,6 +104,19 @@ PILOT_PHASE = os.environ.get("BENCH_PILOT", "0") == "1"
 # against the bucketed leg's own waste_roofline prediction. Recorded in
 # detail.ragged.
 RAGGED_PHASE = os.environ.get("BENCH_RAGGED", "0") == "1"
+# Spec phase: the same greedy closed wave run twice at equal hardware —
+# graftspec speculative decoding (SPEC=1 semantics: draft k, verify in
+# one ragged wave) vs plain decode — so the bench line carries per-leg
+# decode tok/s, the spec leg's acceptance rate and dispatches/token
+# (tools/bench_compare.py gates spec_acceptance_rate higher-is-better
+# and decode tok/s no-regression). BENCH_SPEC_DRAFT picks the drafter:
+# "self" (default — the target's own weights, the CPU-smoke upper
+# bound), "" for the host n-gram drafter, or a preset name ("bench-1b"
+# on the 8B TPU run) for a resident draft model. Recorded in
+# detail.spec.
+SPEC_PHASE = os.environ.get("BENCH_SPEC", "0") == "1"
+SPEC_K = int(os.environ.get("BENCH_SPEC_K", "4"))
+SPEC_DRAFT = os.environ.get("BENCH_SPEC_DRAFT", "self")
 PAGED_DENSE_SLOTS = int(os.environ.get("BENCH_PAGED_DENSE_SLOTS", "4"))
 PAGED_KV_BLOCK = int(os.environ.get("BENCH_PAGED_KV_BLOCK", "16"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
@@ -249,6 +262,8 @@ def _phase_score(line: dict | None) -> int:
     if "chunked" in d:
         s += 1
     if "paged" in d:
+        s += 1
+    if "spec" in d:
         s += 1
     if not d.get("partial"):
         s += 10
@@ -691,11 +706,16 @@ def _sched_counts(engine, req_s: float = 0.0) -> dict:
         "padding_waste_frac": round(pad_frac, 4),
         "goodput_gap": round(
             gap["bucket_pad_frac"] + gap["group_pad_frac"]
-            + gap["frag_frac"], 4
+            + gap["frag_frac"] + gap.get("spec_rejected_frac", 0.0), 4
         ),
         "goodput_gap_breakdown": {k: round(v, 4) for k, v in gap.items()},
         "sched_conservation_breaches": snap["conservation"]["breaches"],
     }
+    spec = snap.get("spec", {})
+    if spec.get("verify_waves"):
+        out["spec_acceptance_rate"] = round(spec["acceptance_rate"], 4)
+        out["spec_drafted_tokens"] = spec["drafted_tokens"]
+        out["spec_accepted_tokens"] = spec["accepted_tokens"]
     if req_s > 0.0:
         out["waste_roofline"] = {
             "ragged_attention_req_s": round(
@@ -1183,6 +1203,104 @@ def _measure_ragged(params, cfg) -> dict:
     }
 
 
+def _measure_spec(params, cfg) -> dict:
+    """BENCH_SPEC phase: one greedy closed wave run twice at equal
+    hardware — plain paged decode vs graftspec speculative decoding on
+    the same substrate, same pool, same slots. Verification is
+    exact-match against deterministic per-row sampling, so the spec leg
+    must reproduce the plain leg's stream bit for bit; the phase
+    asserts that, then prices what speculation bought: per-leg decode
+    tok/s, the spec leg's dispatches/token (< 1.0 means verify waves
+    genuinely compressed the decode loop) and windowed acceptance rate
+    from the sched ledger's spec books."""
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    bs = 16          # KV block
+    new_toks = min(NEW_TOKENS, 16)
+    slots = 8
+    lengths = [24, 48, 96, 16]
+    smax = 128  # max prompt 96 + 16 new + slack, block-aligned
+    n_req = 3 * slots
+    pool_blocks = slots * (smax // bs) + 1  # full residency + trash
+    rng = np.random.default_rng(31)
+    prompts = [
+        rng.integers(3, cfg.vocab_size,
+                     size=(lengths[i % len(lengths)],)).tolist()
+        for i in range(n_req)
+    ]
+
+    if SPEC_DRAFT == "self":
+        draft = (params, cfg)          # acceptance upper bound
+    elif SPEC_DRAFT:
+        draft = _build(SPEC_DRAFT)     # resident draft model
+    else:
+        draft = None                   # host n-gram drafter
+
+    def leg(spec: bool):
+        ecfg = EngineConfig(
+            max_slots=slots,
+            max_seq_len=smax,
+            prompt_buckets=(32, 128),
+            max_admit=4,
+            decode_chunk=4,
+            paged_kv=True, kv_block=bs, kv_pool_blocks=pool_blocks,
+            spec_decode=spec, spec_k=SPEC_K if spec else 4,
+        )
+        engine = InferenceEngine(params, cfg, ecfg,
+                                 draft=draft if spec else None)
+        engine.warmup()
+        engine.start()
+        t0 = time.perf_counter()
+        qs = [engine.submit(p, SamplingParams(
+                  temperature=0.0, top_k=0, top_p=1.0,
+                  max_new_tokens=new_toks, seed=i))
+              for i, p in enumerate(prompts)]
+        streams = []
+        for q in qs:
+            toks = []
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    break
+                if "error" in item:
+                    raise RuntimeError(item["error"])
+                toks.extend(item.get("tokens", []))
+            streams.append(toks)
+        dt = time.perf_counter() - t0
+        stats = engine.stats.snapshot()
+        tok_s = stats["tokens_out"] / dt if dt else 0.0
+        out = {
+            "req_per_s": round(n_req / dt, 3),
+            "decode_tok_s": round(tok_s, 1),
+            "makespan_s": round(dt, 3),
+            "dispatch_per_token": round(
+                stats["decode_dispatches"] / max(1, stats["tokens_out"]), 4
+            ),
+            **_compile_counts(engine),
+            **_sched_counts(engine),
+        }
+        engine.stop()
+        return out, streams
+
+    plain, want = leg(spec=False)
+    spec_leg, got = leg(spec=True)
+    if got != want:  # the whole contract: speculation changes nothing
+        raise RuntimeError("spec leg diverged from plain greedy stream")
+    return {
+        "k": SPEC_K,
+        "drafter": SPEC_DRAFT or "ngram",
+        "plain": plain,
+        "spec": spec_leg,
+        "bit_identical": True,
+        "speedup": (round(spec_leg["decode_tok_s"] / plain["decode_tok_s"],
+                          3) if plain["decode_tok_s"] else None),
+        "acceptance_rate": spec_leg.get("spec_acceptance_rate"),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1266,6 +1384,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"ragged phase failed: {e!r}")
             detail["ragged_error"] = str(e)
+
+    if SPEC_PHASE:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["spec"] = _measure_spec(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"spec phase failed: {e!r}")
+            detail["spec_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

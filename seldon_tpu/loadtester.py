@@ -393,11 +393,23 @@ def _sched_counts(url: str) -> dict:
             "budget_utilization": float(sched["budget_utilization"]),
             "goodput_gap": round(
                 float(gap["bucket_pad_frac"]) + float(gap["group_pad_frac"])
+                + float(gap.get("spec_rejected_frac", 0.0))
                 + float(gap["frag_frac"]), 6
             ),
             "goodput_gap_breakdown": {
                 k: float(v) for k, v in gap.items()
             },
+            # graftspec acceptance accounting (all-zero when SPEC off;
+            # tolerant of a pre-spec server schema).
+            "spec_acceptance_rate": float(
+                sched.get("spec", {}).get("acceptance_rate", 1.0)
+            ),
+            "spec_drafted_tokens": int(
+                sched.get("spec", {}).get("drafted_tokens", 0)
+            ),
+            "spec_accepted_tokens": int(
+                sched.get("spec", {}).get("accepted_tokens", 0)
+            ),
             "sched_conservation_breaches": int(
                 sched["conservation"]["breaches"]
             ),

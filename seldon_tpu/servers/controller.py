@@ -65,13 +65,16 @@ Concurrency contract (the compile-ledger discipline, applied again):
       "period_boundaries": int,   # boundaries per decision window
       "decisions_total": int,
       "decisions_by_knob": {"dispatch_token_budget": int,
-                            "max_admit": int, "chunk_bias": int},
+                            "max_admit": int, "chunk_bias": int,
+                            "spec_k": int},
       "knobs": {"dispatch_token_budget": int,   # live values the
                 "max_admit": int,               # scheduler reads
-                "chunk_bias": int},
+                "chunk_bias": int,
+                "spec_k": int},    # 0 when spec decoding is off
       "envelope": {"budget_min": int, "budget_max": int,
                    "admit_min": int, "admit_max": int,
-                   "bias_min": int, "bias_max": int},
+                   "bias_min": int, "bias_max": int,
+                   "speck_min": int, "speck_max": int},
       "edf": {"inversions": int,      # out-of-order adjacent pairs
               "reorders": int,        #   repaired across all sorts
               "expired_at_pop": int}, # expired heads shed at pop time
@@ -89,7 +92,9 @@ Concurrency contract (the compile-ledger discipline, applied again):
            "budget_dispatches": int, "budget_starved_passes": int,
            "budget_offered_tokens": int, "budget_used_tokens": int,
            "pool_stall_events": int, "preemptions": int,
-           "deadline_expired": int, "goodput": float,
+           "deadline_expired": int,
+           "spec_drafted": int, "spec_accepted": int,
+           "goodput": float,
            "queue_depth": int, "free_slots": int},
          "effect": null | {"goodput_delta": float,
                            "waste_frac_delta": float}},
@@ -129,6 +134,13 @@ STARVED_LO = 0.125
 BUDGET_SURPLUS_UTIL = 0.5
 # Admission hysteresis: lower on any pool stall / preemption in the
 # window (the pool is telling the truth), recover only after calm.
+# Speculation-depth hysteresis band: raise the draft rung when the
+# window's acceptance rate clears HI, lower when it drops under LO.
+# The wide gap is the point — acceptance is workload-phase noisy, and
+# a rung move retraces nothing (both rungs are pre-warmed lattice
+# variants), so the only cost of patience is a slightly-stale k.
+SPEC_ACCEPT_HI = 0.8
+SPEC_ACCEPT_LO = 0.4
 # Virtual deadline for requests that carry none: starvation-proof aging
 # — after this many seconds queued, a no-deadline request outranks any
 # deadline further out than its age.
@@ -139,6 +151,7 @@ LEDGER_CAP = 256
 KNOB_BUDGET = "dispatch_token_budget"
 KNOB_ADMIT = "max_admit"
 KNOB_BIAS = "chunk_bias"
+KNOB_SPECK = "spec_k"
 
 # The cumulative counters a signal snapshot windows over.
 _DELTA_KEYS = (
@@ -146,6 +159,7 @@ _DELTA_KEYS = (
     "budget_dispatches", "budget_starved_passes",
     "budget_offered_tokens", "budget_used_tokens",
     "pool_stall_events", "preemptions", "deadline_expired",
+    "spec_drafted", "spec_accepted",
 )
 # Instantaneous signals copied into the window as-is.
 _LEVEL_KEYS = ("goodput", "queue_depth", "free_slots")
@@ -182,22 +196,27 @@ class PilotController:
         self.admit_max = 1
         self.bias_min = -1
         self.bias_max = 1
+        # Speculation-depth envelope: the engine's pow2 rung ladder.
+        # Empty () means spec decoding is off and the knob is inert.
+        self.spec = False
+        self.speck_rungs: Tuple[int, ...] = ()
         # Live knob values the scheduler reads (via the accessor
         # methods, so cross-class field access never leaks).
         self._pl_budget = 0  # graftlint: guarded-by(_book)
         self._pl_admit = 1  # graftlint: guarded-by(_book)
         self._pl_bias = 0  # graftlint: guarded-by(_book)
+        self._pl_speck = 0  # graftlint: guarded-by(_book)
         # Controller bookkeeping.
         self._pl_boundaries = 0  # graftlint: guarded-by(_book)
         self._pl_windows = 0  # graftlint: guarded-by(_book)
         self._pl_prev: Optional[Dict[str, float]] = None  # graftlint: guarded-by(_book)
         self._pl_cool: Dict[str, int] = {  # graftlint: guarded-by(_book)
-            KNOB_BUDGET: 0, KNOB_ADMIT: 0, KNOB_BIAS: 0,
+            KNOB_BUDGET: 0, KNOB_ADMIT: 0, KNOB_BIAS: 0, KNOB_SPECK: 0,
         }
         self._pl_calm = 0  # consecutive stall-free windows  # graftlint: guarded-by(_book)
         self._pl_meet = 0  # consecutive expiry-free windows  # graftlint: guarded-by(_book)
         self._pl_counts: Dict[str, int] = {  # graftlint: guarded-by(_book)
-            KNOB_BUDGET: 0, KNOB_ADMIT: 0, KNOB_BIAS: 0,
+            KNOB_BUDGET: 0, KNOB_ADMIT: 0, KNOB_BIAS: 0, KNOB_SPECK: 0,
         }
         self._pl_ledger: Deque[Dict[str, Any]] = collections.deque(  # graftlint: guarded-by(_book)
             maxlen=LEDGER_CAP
@@ -216,11 +235,19 @@ class PilotController:
     # --- wiring -------------------------------------------------------------
 
     def bind(self, *, chunked: bool, prefill_chunk: int, max_slots: int,  # graftlint: holds(_book)
-             max_admit: int, dispatch_token_budget: int) -> None:
+             max_admit: int, dispatch_token_budget: int,
+             spec: bool = False,
+             spec_rungs: Tuple[int, ...] = ()) -> None:
         """Capture the validated config envelope.  Called from engine
         __init__ before the engine is published to other threads (the
         lock-guard __init__ exemption applies on the engine side)."""
         self.chunked = bool(chunked)
+        self.spec = bool(spec) and bool(spec_rungs)
+        if self.spec:
+            self.speck_rungs = tuple(spec_rungs)
+            # Neutral default: the deepest rung, exactly what the raw
+            # path uses — pilot-on-at-defaults drafts identical waves.
+            self._pl_speck = self.speck_rungs[-1]
         if self.chunked:
             self.budget_min = prefill_chunk
             self.budget_max = max(prefill_chunk, max_slots * prefill_chunk)
@@ -249,6 +276,13 @@ class PilotController:
     def chunk_bias(self) -> int:  # graftlint: holds(_book)
         """Adaptive-chunk rung bias in [bias_min, bias_max]."""
         return self._pl_bias
+
+    def spec_k(self, current: int) -> int:  # graftlint: holds(_book)
+        """Live speculation depth (a rung from the bound ladder).
+        Inert passthrough when spec was never bound."""
+        if not self.spec:
+            return current
+        return self._pl_speck
 
     # --- EDF ordering -------------------------------------------------------
 
@@ -323,6 +357,7 @@ class PilotController:
         decisions += self._rule_budget(window)
         decisions += self._rule_admit(window, stalled)
         decisions += self._rule_bias(window, expired)
+        decisions += self._rule_speck(window)
         for entry in decisions:
             self._pl_open.append(
                 (entry, float(sig["goodput"]), waste)
@@ -371,6 +406,8 @@ class PilotController:
             self._pl_budget = int(new)
         elif knob == KNOB_ADMIT:
             self._pl_admit = int(new)
+        elif knob == KNOB_SPECK:
+            self._pl_speck = int(new)
         else:
             self._pl_bias = int(new)
         return [entry]
@@ -469,6 +506,41 @@ class PilotController:
             )
         return []
 
+    def _rule_speck(self, w: Dict[str, Any]) -> List[Dict[str, Any]]:  # graftlint: holds(_book)
+        """Speculation depth from the window's measured acceptance
+        rate: drafts the target keeps are nearly free tokens, drafts
+        it rejects are pure verify-lane waste, so k should track how
+        predictable the current traffic actually is."""
+        if not self.spec or self._pl_cool[KNOB_SPECK]:
+            return []
+        drafted = w["spec_drafted"]
+        if drafted <= 0:
+            return []
+        rate = w["spec_accepted"] / drafted
+        old = self._pl_speck
+        i = self.speck_rungs.index(old)
+        if rate >= SPEC_ACCEPT_HI and i + 1 < len(self.speck_rungs):
+            new = self.speck_rungs[i + 1]
+            return self._decide(
+                KNOB_SPECK, old, new,
+                f"acceptance {rate:.0%} over {int(drafted)} drafted "
+                f"tokens clears {SPEC_ACCEPT_HI:.0%}",
+                "deeper drafts; more accepted tokens per verify "
+                "dispatch at unchanged fidelity",
+                w,
+            )
+        if rate <= SPEC_ACCEPT_LO and i > 0:
+            new = self.speck_rungs[i - 1]
+            return self._decide(
+                KNOB_SPECK, old, new,
+                f"acceptance {rate:.0%} over {int(drafted)} drafted "
+                f"tokens under {SPEC_ACCEPT_LO:.0%}",
+                "shallower drafts; less rejected-token waste in the "
+                "verify lane",
+                w,
+            )
+        return []
+
     # --- export -------------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:  # graftlint: holds(_book)
@@ -486,6 +558,7 @@ class PilotController:
                 KNOB_BUDGET: self._pl_budget,
                 KNOB_ADMIT: self._pl_admit,
                 KNOB_BIAS: self._pl_bias,
+                KNOB_SPECK: self._pl_speck,
             },
             "envelope": {
                 "budget_min": self.budget_min,
@@ -494,6 +567,8 @@ class PilotController:
                 "admit_max": self.admit_max,
                 "bias_min": self.bias_min,
                 "bias_max": self.bias_max,
+                "speck_min": self.speck_rungs[0] if self.spec else 0,
+                "speck_max": self.speck_rungs[-1] if self.spec else 0,
             },
             "edf": {
                 "inversions": self._pl_inversions,

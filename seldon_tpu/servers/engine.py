@@ -41,6 +41,7 @@ import numpy as np
 
 from seldon_tpu.core import tracing
 from seldon_tpu.models import ragged_attention, transformer
+from seldon_tpu.models import spec_decode as spec_model
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
 from seldon_tpu.servers import compile_ledger, controller, flight_recorder
@@ -148,6 +149,22 @@ class EngineConfig:
     # byte-identical to the bucketed engine.
     ragged: bool = False
     ragged_chunk: int = 0  # per-slot tokens per wave; 0 -> prefill_chunk
+    # Speculative decoding (opt-in; graftspec): a resident drafter
+    # proposes up to `spec_k` tokens per live slot each wave and the
+    # target model verifies all k+1 positions in ONE wide dispatch
+    # (models/spec_decode.py) — accepted prefixes commit, the first
+    # mismatch rolls the row back by a host-side block-table trim.
+    # Sampling keys are sequential per position, so verification is
+    # EXACT: outputs are bit-identical to the spec-off engine at any
+    # temperature. Requires paged_kv (rollback is a table trim);
+    # mutually exclusive with ragged (each replaces the decode
+    # dispatch). `spec_draft` names a draft checkpoint preset (the 1B
+    # next to an 8B target); "" uses the zero-dispatch n-gram drafter
+    # (servers/spec_decode.py). False keeps every dispatch
+    # byte-identical to the spec-off engine.
+    spec_decode: bool = False
+    spec_k: int = 4  # max drafted tokens/wave; rungs are pow2 1..spec_k
+    spec_draft: str = ""  # draft model preset; "" -> n-gram drafter
     # Request-lifecycle hardening (defaults keep the dispatch path
     # byte-identical): TTL applied to requests that set no
     # SamplingParams.deadline_ms of their own, a bound on the admission
@@ -258,6 +275,25 @@ class EngineConfig:
                     f"({self.kv_block}) so wave boundaries append whole "
                     f"pool blocks"
                 )
+        if self.spec_decode:
+            if not self.paged_kv:
+                raise ValueError(
+                    "spec_decode=True requires paged_kv=True — rollback "
+                    "after a rejected draft is a host-side block-table "
+                    "trim, which only the paged engine supports"
+                )
+            if self.ragged:
+                raise ValueError(
+                    "spec_decode=True is incompatible with ragged=True — "
+                    "each replaces the decode dispatch (a verify wave IS "
+                    "a ragged decode wave with k+1 tokens per slot)"
+                )
+            if not pow2(self.spec_k):
+                raise ValueError(
+                    f"spec_k ({self.spec_k}) must be a power of two — "
+                    f"verify variants compile one rung per pow2 k, and "
+                    f"the pilot walks that ladder"
+                )
         if self.default_deadline_ms < 0:
             raise ValueError(
                 f"default_deadline_ms ({self.default_deadline_ms}) must be "
@@ -301,6 +337,10 @@ class _Request:
     # at — owned and zero-copy-shared alike each carry one allocator ref
     # taken at admission/growth, so release is a uniform unref sweep.
     block_ids: List[int] = dataclasses.field(default_factory=list)
+    # Speculative-decoding state: every token emitted so far, in order —
+    # the drafter's history source (prompt + gen_hist). Only populated
+    # when spec_decode is on; the spec-off engine never appends.
+    gen_hist: List[int] = dataclasses.field(default_factory=list)
     # Observability: when the scheduler first dispatched work for this
     # request (queue-wait = first_dispatch_at - submitted_at) and when its
     # latest token burst was emitted (drives the ITL histogram).
@@ -611,6 +651,7 @@ class InferenceEngine:
         cfg: ModelConfig,
         engine_cfg: Optional[EngineConfig] = None,
         mesh=None,
+        draft: Optional[Tuple[Any, ModelConfig]] = None,
     ):
         self.cfg = cfg.validate()
         self.ecfg = engine_cfg or EngineConfig()
@@ -882,6 +923,75 @@ class InferenceEngine:
                 ),
                 donate_argnums=(1,),
             )
+        # graftspec (opt-in): speculative decoding. Each boundary a
+        # drafter proposes up to spec_k tokens per live decode slot and
+        # ONE wide verify dispatch (models/spec_decode.verify_wave)
+        # scores all k+1 positions against the paged pool — the decode
+        # chunk ladder never dispatches; ("verify", k) rungs replace it
+        # in the lattice. Verification is exact-match against the
+        # target's own sequentially-keyed samples, so output streams
+        # are bit-identical to spec-off at ANY temperature. Requires
+        # the paged engine (validated in EngineConfig); inherits its
+        # single-process restriction through self._paged. The loop runs
+        # synchronously (process-before-next-dispatch) because rollback
+        # must trim block-table tails before the next wave sizes its
+        # block growth.
+        self._spec = bool(self.ecfg.spec_decode) and self._paged
+        if self.ecfg.spec_decode and not self._spec:
+            logger.warning(
+                "spec_decode disabled: the paged engine it rides on was "
+                "disabled (multi-process mesh)"
+            )
+        self._jit_verify = None
+        self._jit_draft = None
+        self._drafter = None
+        if self._spec:
+            from seldon_tpu.servers import spec_decode as spec_host
+
+            self._async_fetch = False
+            # Pow2 k ladder 1..spec_k: one verify compile per rung, and
+            # the pilot's spec_k knob walks rung-to-rung.
+            self._spec_rungs = tuple(
+                1 << i for i in range(self.ecfg.spec_k.bit_length())
+            )
+            self._spec_k_live = self._spec_rungs[-1]  # graftlint: guarded-by(_book)
+            self._jit_verify = jax.jit(
+                functools.partial(
+                    self._verify_impl, cfg=self.cfg, mesh=mesh,
+                ),
+                donate_argnums=(1,),
+            )
+            # Draft model (optional second checkpoint): greedy k-token
+            # proposal over a fixed sliding history window, one jit per
+            # rung keyed ("draft", k). Without it the host-side n-gram
+            # drafter proposes for free.
+            self._draft_cfg = None
+            self._spec_window = min(64, Smax)
+            if draft is not None:
+                dparams, dcfg = draft
+                self._draft_cfg = dcfg.validate()
+                self._jit_draft = {
+                    kk: jax.jit(
+                        functools.partial(
+                            spec_model.draft_tokens,
+                            dparams,
+                            cfg=self._draft_cfg,
+                            k=kk,
+                        )
+                    )
+                    for kk in self._spec_rungs
+                }
+            self._drafter = spec_host.make_drafter(
+                self._jit_draft, self._spec_window, self.cfg.pad_token_id
+            )
+            # Acceptance accounting (host, under _book): feeds gauges,
+            # /debug/sched via the sled, and the pilot's spec_k rule.
+            self._spec_drafted = 0  # graftlint: guarded-by(_book)
+            self._spec_accepted = 0  # graftlint: guarded-by(_book)
+            self._spec_waves = 0  # graftlint: guarded-by(_book)
+            # In-flight wave descriptor (k, wave mask, n_wave) between
+            # dispatch and _spec_post_process.
+            self._spec_wave = None  # graftlint: guarded-by(_book)
         # Request-scoped tracing + flight recorder (both env-gated, both
         # zero hot-path cost when off). Lifecycle spans are emitted
         # retroactively at terminal time from _Request timestamps;
@@ -946,6 +1056,8 @@ class InferenceEngine:
                 max_slots=self.ecfg.max_slots,
                 max_admit=self._max_admit,
                 dispatch_token_budget=self.ecfg.dispatch_token_budget,
+                spec=self._spec,
+                spec_rungs=self._spec_rungs if self._spec else (),
             )
         # Runtime concurrency sanitizer (GRAFTSAN=1; None — and zero
         # hot-path code — otherwise). Wraps every lock above in an
@@ -1505,6 +1617,23 @@ class InferenceEngine:
         )
         return state, first, first_done, toks, valid, active
 
+    @staticmethod
+    def _verify_impl(params, state, table, drafts, wave, *, cfg,
+                     mesh=None):
+        """graftspec: ONE wide verify dispatch replacing up to k + 1
+        sequential decode steps (models/spec_decode.verify_wave). The
+        k rung is carried by the drafts width — one compile per rung,
+        keyed ("verify", k) in the lattice. Returns the decode chunk's
+        exact contract (toks/valid are [k+1, B] True-prefix columns),
+        so _process_chunk consumes a wave unchanged."""
+        state, toks, valid = spec_model.verify_wave(
+            params, state, table, drafts, wave, cfg
+        )
+        toks, valid, active = InferenceEngine._replicate(
+            mesh, toks, valid, state["active"]
+        )
+        return state, toks, valid, active
+
     # --- public API ---------------------------------------------------------
 
     def submit(
@@ -1923,6 +2052,9 @@ class InferenceEngine:
             ) if chunked else 0,
             ragged=self._ragged,
             ragged_chunk=self._ragged_chunk if self._ragged else 0,
+            spec=self._spec,
+            spec_rungs=self._spec_rungs if self._spec else (),
+            spec_draft=self._jit_draft is not None,
         )
 
     def static_lattice(self) -> List[str]:
@@ -2101,6 +2233,30 @@ class InferenceEngine:
             self._state = self._jit_seed_prefix(
                 self._state, pkv, jnp.int32(0)
             )
+        elif kind == "verify" and self._spec:
+            # The wide spec wave at rung k: all-trash tables and an
+            # all-False wave mask (every scatter routes past the table,
+            # every acceptance chain is run=False) keep the compile a
+            # pure no-op over real state.
+            _, kk = key
+            B = self.ecfg.max_slots
+            self._state, _, _, _ = self._jit_verify(
+                self.params,
+                self._state,
+                jnp.zeros((B, self._nbs), jnp.int32),
+                jnp.zeros((B, kk), jnp.int32),
+                jnp.zeros((B,), jnp.bool_),
+            )
+        elif kind == "draft" and self._jit_draft is not None:
+            # Draft-model proposal at rung k over its scratch cache —
+            # stateless by design, so the warm call touches no engine
+            # state at all.
+            _, kk = key
+            B = self.ecfg.max_slots
+            self._jit_draft[kk](
+                jnp.zeros((B, self._spec_window), jnp.int32),
+                jnp.ones((B,), jnp.int32),
+            )
         else:
             raise ValueError(
                 f"lattice key {key!r} has no warm recipe for this "
@@ -2265,6 +2421,8 @@ class InferenceEngine:
             "goodput": met / finished if finished else 1.0,
             "queue_depth": len(self._waiting),
             "free_slots": len(self._free),
+            "spec_drafted": sled["spec"]["drafted_tokens"],
+            "spec_accepted": sled["spec"]["accepted_tokens"],
         }
 
     def _pilot_tick(self) -> None:  # graftlint: holds(_book)
@@ -3382,6 +3540,241 @@ class InferenceEngine:
         self._dispatch_wreck = None
         return (admits, (toks_d, valid_d, active_d), roster, timing)
 
+    # --- speculative decoding (graftspec) ----------------------------------
+
+    def _pick_spec_k(self) -> int:  # graftlint: holds(_book)
+        """Current verify rung: the top of the compiled pow2 ladder, or
+        the pilot's spec_k knob when flying (the pilot's envelope is
+        the ladder itself, so it never leaves compiled variants)."""
+        k = self._spec_k_live
+        if self._pilot is not None:
+            k = self._pilot.spec_k(k)
+        if k not in self._spec_rungs:
+            k = self._spec_rungs[-1]
+        self._spec_k_live = k
+        return k
+
+    def _collect_drafts(self, k: int):  # graftlint: holds(_book)
+        """Host-side draft proposal for every armed decode row. Returns
+        (drafts [B, k] int32, wave [B] bool, n_wave). Rows admitted
+        THIS boundary are not yet in _active_host and sit the wave out
+        (they join the next one) — per-row sequential keys make the
+        emitted stream identical either way. The model drafter runs
+        ONE ("draft", k) dispatch for the whole wave; the n-gram
+        drafter is pure host arithmetic."""
+        B = self.ecfg.max_slots
+        drafts = np.zeros((B, k), np.int32)
+        wave = self._active_host.copy()
+        rows: List[Tuple[int, _Request]] = []
+        for slot in np.flatnonzero(wave):
+            req = self._slots[slot]
+            if req is None or req.finished or req.prefilling:
+                wave[slot] = False
+                continue
+            rows.append((int(slot), req))
+        if not rows:
+            return drafts, wave, 0
+        if self._drafter.uses_model:
+            hists = [
+                (slot, list(req.tokens) + req.gen_hist)
+                for slot, req in rows
+            ]
+            if self._observe:
+                t0 = time.perf_counter()
+            out = self._drafter.draft_batch(hists, k, B)
+            if self._observe:
+                self._note_dispatch(("draft", k), -1,
+                                    time.perf_counter() - t0)
+            for slot, _ in rows:
+                drafts[slot] = out[slot]
+        else:
+            for slot, req in rows:
+                drafts[slot] = self._drafter.draft(
+                    req.tokens, req.gen_hist, k
+                )
+        return drafts, wave, len(rows)
+
+    def _dispatch_spec(self):  # graftlint: holds(_book)
+        """graftspec scheduler step: admissions exactly as the bucketed
+        engine, then — in place of the decode chunk — one host draft
+        pass plus ONE wide ("verify", k) dispatch covering every armed
+        decode row at k + 1 positions each. Every acceptance-dependent
+        piece of bookkeeping (sled attribution, expected resync, block
+        rollback, pilot tick) runs at process time
+        (_spec_post_process): how many tokens a wave emitted is
+        unknowable until its results land, which is also why the spec
+        loop never pipelines (_loop_sync_spec)."""
+        admits = (
+            self._dispatch_prefill_chunks() if self._chunked
+            else self._dispatch_admits()
+        )
+        self._dispatch_wreck = (admits, None, None, None)
+        chunk_handles = None
+        roster = None
+        if admits or self._active_host.any():
+            roster = self._roster()
+            self._dispatch_wreck = (admits, None, roster, None)
+            if self._active_host.any():
+                k = self._pick_spec_k()
+                drafts, wave, n_wave = self._collect_drafts(k)
+                self._spec_wave = (k, wave, n_wave)
+                self._chaos_dispatch("decode")
+                # k + 1 worst-case new positions per row; expected is
+                # EXACT under spec (resynced to n_generated every
+                # boundary), so growth covers pos0 .. pos0 + k and
+                # nothing beyond.
+                self._grow_decode_blocks(k + 1)
+                if self._observe:
+                    t0 = time.perf_counter()
+                self._state, toks, valid, active_after = self._jit_verify(
+                    self.params,
+                    self._state,
+                    jnp.asarray(self._table_host),
+                    jnp.asarray(drafts),
+                    jnp.asarray(wave),
+                )
+                if self._observe:
+                    self._note_dispatch(("verify", k), -1,
+                                        time.perf_counter() - t0)
+                chunk_handles = (toks, valid, active_after)
+                with self.stats.lock:
+                    self.stats.decode_dispatches += 1
+                    self.stats.decode_steps += 1
+                for h in chunk_handles:
+                    h.copy_to_host_async()
+            for _, _, f, d in admits:
+                f.copy_to_host_async()
+                d.copy_to_host_async()
+        if admits or chunk_handles is not None:
+            if self._timing_on:
+                timing = (time.perf_counter(), self._wave_keys)
+                self._wave_keys = []
+            else:
+                timing = None
+            self._dispatch_wreck = None
+            return (admits, chunk_handles, roster, timing)
+        self._dispatch_wreck = None
+        return None
+
+    def _spec_post_process(self, chunk_data, roster) -> None:  # graftlint: holds(_book)
+        """Boundary tail under SPEC=1 (called from _process_boundary
+        after _process_chunk delivered the wave's tokens): acceptance
+        accounting, per-row rollback, and the observability taps the
+        bucketed path runs at dispatch time.
+
+        Acceptance convention: a row that emitted e tokens (1 <= e <=
+        k + 1) accepted e - 1 drafts — the drafts that each saved a
+        sequential decode step. A draft that matched but fell after a
+        terminal token counts rejected: it saved nothing. Under this
+        convention accepted + rejected == drafted and emitted +
+        rejected == (k + 1) * wave rows hold exactly, which is what
+        the sled's conservation audit re-checks every boundary.
+
+        Rollback is pure host bookkeeping: the wave already committed
+        all k + 1 positions through the block tables, but positions
+        past a row's accepted prefix are dead — the next wave's
+        in-layer view scatter rewrites them before any mask exposes
+        them — so rejecting is: resync expected to the true
+        n_generated and unref the table tail past the new position.
+        Freed blocks may be re-owned immediately; the new owner's
+        scatter is queued after this wave device-side."""
+        wave_info, self._spec_wave = self._spec_wave, None
+        emitted = accepted = rejected = drafted = 0
+        k = n_wave = 0
+        if chunk_data is not None and wave_info is not None:
+            k, wave, n_wave = wave_info
+            if n_wave:
+                _, valid_h, _ = chunk_data
+                emitted = int(valid_h.sum(axis=0)[wave].sum())
+                cells = (k + 1) * n_wave
+                drafted = k * n_wave
+                accepted = emitted - n_wave
+                rejected = cells - emitted
+                self._spec_drafted += drafted
+                self._spec_accepted += accepted
+                self._spec_waves += 1
+                if self._sled is not None:
+                    self._sled.note_group(
+                        ("verify", k), cells, emitted, 0, 0,
+                        spec_rejected=rejected,
+                    )
+                    self._sled.note_spec(drafted, accepted, rejected)
+                with self.stats.lock:
+                    self.stats.sched_useful_tokens += emitted
+            bs = self._kv_block
+            for slot, req in enumerate(roster or []):
+                if req is None or not wave_info[1][slot]:
+                    continue
+                if req.finished or self._slots[slot] is not req:
+                    continue  # completed/failed rows released in full
+                req.expected = req.n_generated
+                pos_new = len(req.tokens) + req.n_generated - 1
+                keep = min(self._nbs, pos_new // bs + 1)
+                if len(req.block_ids) > keep:
+                    for bid in req.block_ids[keep:]:
+                        self._allocator.unref(bid)
+                    self._table_host[slot, keep:len(req.block_ids)] = 0
+                    del req.block_ids[keep:]
+        wf = 0.0
+        if self._sled is not None:
+            self._sled.note_boundary()
+            wf = self._sled.boundary_waste()
+            with self.stats.lock:
+                self.stats.record_waste_locked(wf)
+        if self._pilot is not None:
+            self._pilot_tick()
+        if self._recorder is not None:
+            detail = {
+                "active": int(self._active_host.sum()),
+                "pool_free": int(self._allocator.free_count),
+            }
+            if n_wave:
+                detail.update(
+                    verify_k=k, wave=n_wave, emitted=emitted,
+                    accepted=accepted, rejected=rejected,
+                )
+            if self._sled is not None:
+                detail["waste_frac"] = round(wf, 4)
+            self._recorder.record("boundary", -1, detail)
+
+    def _loop_sync_spec(self) -> None:
+        """Synchronous UNPIPELINED scheduler loop under SPEC=1: every
+        boundary is processed before the next dispatch. Pipelining is
+        structurally off because the next wave depends on THIS wave's
+        acceptance results three ways — the drafter reads the emitted
+        history, _grow_decode_blocks sizes k + 1 positions from the
+        resynced expected, and rollback trims the tables the next
+        dispatch snapshots. The wide verify dispatch amortizes the
+        round trip the pipeline used to hide: one sync per up-to-(k+1)
+        tokens per row instead of one per chunk."""
+        while not self._stop.is_set():
+            try:
+                with self._book:
+                    work = self._dispatch_once()
+                    if work is not None:
+                        self._process_boundary(*work)
+                    idle = (
+                        work is None and not self._active_host.any()
+                    )
+                if self._profile_n and work is not None:
+                    self._profile_tick()
+                # Sleep outside the lock so drain()/cancel() never wait
+                # on an idle tick.
+                if idle and self._pending.empty():
+                    if self._sled is not None:
+                        self._sled.note_idle()
+                        with self.stats.lock:
+                            self.stats.sched_idle_boundaries += 1
+                    time.sleep(self.ecfg.idle_sleep_s)
+            except Exception as e:  # fail requests, reset, keep serving
+                logger.exception("engine iteration failed")
+                with self._book:
+                    wreck, self._dispatch_wreck = (
+                        self._dispatch_wreck, None
+                    )
+                    self._spec_wave = None
+                    self._fail_all(str(e), [wreck])
+
     # --- boundary processing -----------------------------------------------
 
     def _process_admits(  # graftlint: holds(_book)
@@ -3416,6 +3809,8 @@ class InferenceEngine:
                 ttft_ms = 1000.0 * (now - req.submitted_at)
                 ttft_total += ttft_ms
                 req.n_generated = 1
+                if self._spec:
+                    req.gen_hist.append(first_tok)
                 req.out.put({"tokens": [first_tok], "ttft_ms": ttft_ms})
                 if bool(done_h[idx]):
                     self._complete(req)
@@ -3445,7 +3840,10 @@ class InferenceEngine:
                 continue
             n = int(n_valid[slot])
             if n:
-                req.out.put({"tokens": toks_h[:n, slot].tolist()})
+                burst = toks_h[:n, slot].tolist()
+                if self._spec:
+                    req.gen_hist.extend(burst)
+                req.out.put({"tokens": burst})
                 req.n_generated += n
                 total += n
                 if req.last_burst_at is not None:
@@ -3601,6 +3999,8 @@ class InferenceEngine:
         there."""
         if self._san is not None:
             self._san.assert_holds("_book")
+        if self._spec:
+            self._spec_wave = None  # descriptor of a wave now wrecked
         if self._recorder is not None:
             self._recorder.record("fail-all", -1, {"error": err[:200]})
         live: Dict[int, _Request] = {}
@@ -3672,6 +4072,8 @@ class InferenceEngine:
         self._process_admits(admits, admit_data)
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
+        if self._spec:
+            self._spec_post_process(chunk_data, roster)
         self._record_wave_timing(timing)
         if self._san is not None:
             self._san.audit(self)
@@ -4013,6 +4415,10 @@ class InferenceEngine:
             # graftragged: the whole step is ONE fused wave — no
             # separate admission groups or decode chunk below.
             return self._dispatch_ragged()
+        if self._spec:
+            # graftspec: admissions as usual, then a draft pass + one
+            # wide verify dispatch instead of the decode chunk.
+            return self._dispatch_spec()
         admits = (
             self._dispatch_prefill_chunks() if self._chunked
             else self._dispatch_admits()
@@ -4102,6 +4508,9 @@ class InferenceEngine:
         # debug_lifecycle_check() read the same state from other threads.
         if self._ragged:
             self._loop_sync_ragged()
+            return
+        if self._spec:
+            self._loop_sync_spec()
             return
         pending: Optional[Tuple[list, Any, list, Any]] = None
         while not self._stop.is_set():

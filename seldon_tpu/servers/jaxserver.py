@@ -66,6 +66,9 @@ class JAXServer(SeldonComponent):
         kv_pool_mb: int = 0,
         ragged: int = -1,
         ragged_chunk: int = 0,
+        spec: int = -1,
+        spec_k: int = 0,
+        spec_draft: str = "",
         max_queue: int = 0,
         default_deadline_ms: int = 0,
     ):
@@ -144,6 +147,25 @@ class JAXServer(SeldonComponent):
         if self.ragged:
             self.paged_kv = True
             self.chunked_prefill = True
+        # graftspec speculative decoding (servers/engine.py
+        # _dispatch_spec + models/spec_decode.py): unit parameter, or
+        # SPEC=1 / SPEC_K / SPEC_DRAFT env. Implies paged_kv (rollback
+        # after a rejected draft is a host-side block-table tail trim),
+        # so SPEC=1 alone is a complete switch. SPEC_DRAFT names a
+        # preset for the resident draft model (e.g. the 1B next to an
+        # 8B target); empty uses the zero-dispatch n-gram drafter.
+        # -1 / 0 = follow the env (default off).
+        if int(spec) < 0:
+            spec = int(_os.environ.get("SPEC", "0") or 0)
+        self.spec = bool(int(spec))
+        self.spec_k = int(
+            spec_k or _os.environ.get("SPEC_K", "0") or 0
+        )
+        self.spec_draft = (
+            spec_draft or _os.environ.get("SPEC_DRAFT", "")
+        )
+        if self.spec:
+            self.paged_kv = True
         # Request-lifecycle hardening (servers/engine.py): bounded
         # admission queue (submit sheds with 429 EngineOverloaded past
         # this depth; 0 = unbounded) and a default per-request TTL in ms
@@ -300,6 +322,36 @@ class JAXServer(SeldonComponent):
                 ekw["ragged"] = True
                 if self.ragged_chunk:
                     ekw["ragged_chunk"] = self.ragged_chunk
+            draft = None
+            if self.spec:
+                ekw["spec_decode"] = True
+                if self.spec_k:
+                    ekw["spec_k"] = self.spec_k
+                if self.spec_draft:
+                    # Resident draft model: preset-only (the draft rides
+                    # the target's mesh and tokenizer — its proposals
+                    # must be valid target token ids, so eos/pad are
+                    # aligned to the target config here).
+                    ekw["spec_draft"] = self.spec_draft
+                    dcfg = get_config(
+                        self.spec_draft,
+                        eos_token_id=cfg.eos_token_id,
+                        pad_token_id=cfg.pad_token_id,
+                    )
+                    with mesh:
+                        dparams = jax.jit(
+                            lambda k: transformer.init_params(dcfg, k),
+                            out_shardings=shd.named_shardings(
+                                mesh, shd.param_pspecs(dcfg)
+                            ),
+                        )(jax.random.key(self.init_seed + 1))
+                    if dcfg.weight_dtype == "int8":
+                        from seldon_tpu.models.quantize import (
+                            quantize_params,
+                        )
+
+                        dparams = quantize_params(dparams)
+                    draft = (dparams, dcfg)
             if self.max_queue:
                 ekw["max_queue"] = self.max_queue
             if self.default_deadline_ms:
@@ -314,6 +366,7 @@ class JAXServer(SeldonComponent):
                     **ekw,
                 ),
                 mesh=mesh,
+                draft=draft,
             )
             if self.warmup:
                 self.engine.warmup()
@@ -647,6 +700,25 @@ class JAXServer(SeldonComponent):
                     "value": float(sched["wait"][comp]),
                     "tags": {"component": comp},
                 })
+            if self.spec:
+                spec = sched["spec"]
+                out.extend([
+                    {"type": "GAUGE",
+                     "key": "jaxserver_spec_acceptance_rate",
+                     "value": float(spec["acceptance_rate"])},
+                    {"type": "GAUGE",
+                     "key": "jaxserver_spec_drafted_tokens",
+                     "value": float(spec["drafted_tokens"])},
+                    {"type": "GAUGE",
+                     "key": "jaxserver_spec_accepted_tokens",
+                     "value": float(spec["accepted_tokens"])},
+                    {"type": "GAUGE",
+                     "key": "jaxserver_spec_rejected_tokens",
+                     "value": float(spec["rejected_tokens"])},
+                    {"type": "GAUGE",
+                     "key": "jaxserver_spec_verify_waves",
+                     "value": float(spec["verify_waves"])},
+                ])
         pilot = self.engine.debug_pilot()
         if pilot is not None:
             for knob, n in sorted(pilot["decisions_by_knob"].items()):
@@ -661,6 +733,8 @@ class JAXServer(SeldonComponent):
                  "value": float(pilot["knobs"]["dispatch_token_budget"])},
                 {"type": "GAUGE", "key": "jaxserver_pilot_admit_current",
                  "value": float(pilot["knobs"]["max_admit"])},
+                {"type": "GAUGE", "key": "jaxserver_pilot_spec_k_current",
+                 "value": float(pilot["knobs"]["spec_k"])},
                 {"type": "GAUGE", "key": "jaxserver_pilot_edf_inversions",
                  "value": float(pilot["edf"]["inversions"])},
                 {"type": "GAUGE", "key": "jaxserver_pilot_goodput_delta",

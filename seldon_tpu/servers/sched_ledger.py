@@ -5,8 +5,11 @@ The compile ledger says what compiled and the flight recorder says what
 ran; neither says why dispatched capacity was not useful tokens.  This
 ledger attributes every token-slot the scheduler offered to exactly one
 of: useful prompt/chunk work, bucket padding (a prompt or chunk rounded
-up to its lattice bucket), or group padding (admission groups replicated
-up to the next power of two).  Chunked-prefill budget passes additionally
+up to its lattice bucket), group padding (admission groups replicated
+up to the next power of two), or — under SPEC=1 — rejected draft
+positions (verify-wave slots whose proposed token the target refused;
+graftspec's speculative price, the fourth waste category).  Chunked-
+prefill budget passes additionally
 record fragmentation — dispatch-token-budget left on the table while
 prefill work was still queued — and scheduler ticks with nothing to do
 at all count as idle boundaries.  Alongside the token ledger it keeps a
@@ -32,8 +35,12 @@ Design constraints (the compile-ledger discipline, applied again):
 Conservation invariants (checked by ``audit()``; gated in CI by
 ``tools/sched_audit.py`` via ``make sched-audit``):
 
- * ``useful_tokens + bucket_pad_tokens + group_pad_tokens ==
-   dispatch_cells`` — every offered token-slot attributed, exactly;
+ * ``useful_tokens + bucket_pad_tokens + group_pad_tokens +
+   spec_rejected_tokens == dispatch_cells`` — every offered token-slot
+   attributed, exactly;
+ * ``spec.accepted_tokens + spec.rejected_tokens ==
+   spec.drafted_tokens`` — every drafted token resolved one way
+   (re-summed in CI by ``tools/spec_audit.py`` via ``make spec-audit``);
  * ``frag_tokens <= budget_offered_tokens - budget_used_tokens`` —
    fragmentation only counts budget left while work was still queued;
  * the wait components sum to the total measured wait within 1%.
@@ -48,6 +55,7 @@ Conservation invariants (checked by ``audit()``; gated in CI by
       "useful_tokens": int,
       "bucket_pad_tokens": int,
       "group_pad_tokens": int,
+      "spec_rejected_tokens": int,  # rejected verify-wave positions
       "frag_tokens": int,
       "budget_offered_tokens": int, # chunked-prefill budget passes
       "budget_used_tokens": int,
@@ -57,8 +65,16 @@ Conservation invariants (checked by ``audit()``; gated in CI by
       "goodput_gap": {              # fractions of offered opportunity
         "bucket_pad_frac": float,   #   (cells + frag tokens) lost to
         "group_pad_frac": float,    #   each cause; idle_frac is the
-        "frag_frac": float,         #   share of scheduler ticks that
-        "idle_frac": float,         #   dispatched nothing at all
+        "spec_rejected_frac": float,#   share of scheduler ticks that
+        "frag_frac": float,         #   dispatched nothing at all
+        "idle_frac": float,
+      },
+      "spec": {                     # graftspec acceptance accounting
+        "drafted_tokens": int,      #   (all zero when SPEC is off)
+        "accepted_tokens": int,
+        "rejected_tokens": int,
+        "verify_waves": int,
+        "acceptance_rate": float,   # accepted / drafted (1.0 if none)
       },
       "pool_stall_events": int,
       "pool_stall_requests": int,   # requests whose admission stalled
@@ -72,7 +88,8 @@ Conservation invariants (checked by ``audit()``; gated in CI by
       "by_shape": [                 # per-variant waste, compile-ledger
         {"key": str,                #   key spellings ("admit/64/4")
          "dispatches": int, "cells": int, "useful_tokens": int,
-         "bucket_pad_tokens": int, "group_pad_tokens": int}
+         "bucket_pad_tokens": int, "group_pad_tokens": int,
+         "spec_rejected_tokens": int}
       ],
     }
 """
@@ -112,6 +129,7 @@ class SchedLedger:
         self._useful = 0
         self._bucket_pad = 0
         self._group_pad = 0
+        self._spec_rejected = 0
         self._frag = 0
         self._budget_offered = 0
         self._budget_used = 0
@@ -120,7 +138,13 @@ class SchedLedger:
         self._pool_stall_requests = 0
         self._preemptions = 0
         self._preempted_tokens = 0
-        # key -> [dispatches, cells, useful, bucket_pad, group_pad]
+        # graftspec acceptance accounting: every drafted token resolves
+        # to accepted or rejected (audited below).
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_waves = 0
+        # key -> [dispatches, cells, useful, bucket_pad, group_pad,
+        #         spec_rejected]
         self._shapes: Dict[Key, List[int]] = {}
         # Queue-wait decomposition: rid -> first-cause timestamps, popped
         # at first dispatch; _budget_full_at is the latest budget pass
@@ -145,29 +169,48 @@ class SchedLedger:
     # -- hot path (scheduler thread) -----------------------------------------
 
     def note_group(self, key: Key, cells: int, useful: int,
-                   bucket_pad: int, group_pad: int) -> None:
-        """One dispatched admission/chunk group: `cells` token-slots
-        offered by its static shape, split exactly into useful prompt
-        tokens, bucket padding and pow2 group-replication padding."""
+                   bucket_pad: int, group_pad: int,
+                   spec_rejected: int = 0) -> None:
+        """One dispatched admission/chunk/verify group: `cells` token-
+        slots offered by its static shape, split exactly into useful
+        tokens, bucket padding, pow2 group-replication padding and —
+        for graftspec verify waves — rejected draft positions."""
         self._cells += cells
         self._useful += useful
         self._bucket_pad += bucket_pad
         self._group_pad += group_pad
+        self._spec_rejected += spec_rejected
         self._wave_cells += cells
-        self._wave_pad += bucket_pad + group_pad
+        self._wave_pad += bucket_pad + group_pad + spec_rejected
         rec = self._shapes.get(key)
         if rec is None:
             if len(self._shapes) >= _MAX_SHAPES:
                 key = _OVERFLOW_KEY
                 rec = self._shapes.get(key)
             if rec is None:
-                rec = [0, 0, 0, 0, 0]
+                rec = [0, 0, 0, 0, 0, 0]
                 self._shapes[key] = rec
         rec[0] += 1
         rec[1] += cells
         rec[2] += useful
         rec[3] += bucket_pad
         rec[4] += group_pad
+        rec[5] += spec_rejected
+
+    def note_spec(self, drafted: int, accepted: int,
+                  rejected: int) -> None:
+        """One verify wave's acceptance split. `rejected` is carried by
+        the caller (not derived) so audit() can re-sum the identity
+        accepted + rejected == drafted from independently-counted
+        inputs."""
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        self._spec_waves += 1
+        if accepted + rejected != drafted:
+            self._breach(
+                f"spec wave accounting: accepted {accepted} + rejected "
+                f"{rejected} != drafted {drafted}"
+            )
 
     def note_budget(self, offered: int, used: int, starved: bool) -> None:
         """One chunked-prefill budget pass. `starved`: prefill work was
@@ -264,12 +307,20 @@ class SchedLedger:
         under ``_book``, so the identities below can never be
         legitimately torn here; a breach is real attribution drift."""
         self._audit_checked += 1
-        attributed = self._useful + self._bucket_pad + self._group_pad
+        attributed = (self._useful + self._bucket_pad + self._group_pad
+                      + self._spec_rejected)
         if attributed != self._cells:
             self._breach(
                 f"attributed tokens {attributed} != dispatched cells "
                 f"{self._cells} (useful {self._useful} + bucket "
-                f"{self._bucket_pad} + group {self._group_pad})"
+                f"{self._bucket_pad} + group {self._group_pad} + spec "
+                f"rejected {self._spec_rejected})"
+            )
+        if self._spec_rejected > self._spec_drafted - self._spec_accepted:
+            self._breach(
+                f"spec rejected cells {self._spec_rejected} exceed "
+                f"unaccepted drafts "
+                f"{self._spec_drafted - self._spec_accepted}"
             )
         if self._frag > self._budget_offered - self._budget_used:
             self._breach(
@@ -310,12 +361,14 @@ class SchedLedger:
             "useful_tokens": self._useful,
             "bucket_pad_tokens": self._bucket_pad,
             "group_pad_tokens": self._group_pad,
+            "spec_rejected_tokens": self._spec_rejected,
             "frag_tokens": frag,
             "budget_offered_tokens": self._budget_offered,
             "budget_used_tokens": self._budget_used,
             "budget_starved_passes": self._budget_starved,
             "padding_waste_frac": (
-                round((self._bucket_pad + self._group_pad) / cells, 6)
+                round((self._bucket_pad + self._group_pad
+                       + self._spec_rejected) / cells, 6)
                 if cells else 0.0
             ),
             "budget_utilization": (
@@ -331,6 +384,10 @@ class SchedLedger:
                     round(self._group_pad / opportunity, 6)
                     if opportunity else 0.0
                 ),
+                "spec_rejected_frac": (
+                    round(self._spec_rejected / opportunity, 6)
+                    if opportunity else 0.0
+                ),
                 "frag_frac": (
                     round(frag / opportunity, 6) if opportunity else 0.0
                 ),
@@ -343,6 +400,18 @@ class SchedLedger:
             "pool_stall_requests": self._pool_stall_requests,
             "preemptions": self._preemptions,
             "preempted_tokens": self._preempted_tokens,
+            "spec": {
+                "drafted_tokens": self._spec_drafted,
+                "accepted_tokens": self._spec_accepted,
+                "rejected_tokens": (
+                    self._spec_drafted - self._spec_accepted
+                ),
+                "verify_waves": self._spec_waves,
+                "acceptance_rate": (
+                    round(self._spec_accepted / self._spec_drafted, 6)
+                    if self._spec_drafted else 1.0
+                ),
+            },
             "wait": {
                 "requests": self._wait_requests,
                 "total_ms": round(self._wait_total_ms, 3),
@@ -364,6 +433,7 @@ class SchedLedger:
                     "useful_tokens": v[2],
                     "bucket_pad_tokens": v[3],
                     "group_pad_tokens": v[4],
+                    "spec_rejected_tokens": v[5],
                 }
                 for k, v in sorted(shapes.items(), key=lambda kv:
                                    key_str(kv[0]))

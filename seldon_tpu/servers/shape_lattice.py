@@ -71,6 +71,8 @@ FAMILIES = {
     "cow": 1,            # copy-on-write block copy (traced scalars)
     "decode": 2,         # (tag, chunk-ladder rung)
     "ragged": 2,         # (tag, per-slot chunk capacity) — the ONE wave
+    "draft": 2,          # (tag, spec rung k) — draft-model proposal
+    "verify": 2,         # (tag, spec rung k) — the wide verify wave
 }
 
 
@@ -99,6 +101,14 @@ class LatticeSpec:
     # key space collapse to the single ("ragged", C) variant.
     ragged: bool = False
     ragged_chunk: int = 0           # engine _ragged_chunk (per-slot C)
+    # graftspec (models/spec_decode.py): the decode chunk ladder never
+    # dispatches — one ("verify", k) rung per pow2 k replaces it, plus
+    # the ("draft", k) ladder when a draft checkpoint is resident.
+    # Admission families are untouched (spec only changes the decode
+    # leg of each boundary).
+    spec: bool = False
+    spec_rungs: Tuple[int, ...] = ()  # engine _spec_rungs (pow2 1..k)
+    spec_draft: bool = False        # draft-model jit ladder exists
 
     def __post_init__(self):
         if not self.buckets:
@@ -119,6 +129,20 @@ class LatticeSpec:
                 "ragged spec needs paged + chunked engines and a "
                 "positive ragged_chunk (EngineConfig validates the same)"
             )
+        if self.spec:
+            if not self.paged or self.ragged:
+                raise ValueError(
+                    "spec needs the paged engine and excludes ragged — "
+                    "each replaces the decode dispatch (EngineConfig "
+                    "validates the same)"
+                )
+            if not self.spec_rungs or any(
+                kk <= 0 or kk & (kk - 1) for kk in self.spec_rungs
+            ):
+                raise ValueError(
+                    f"spec_rungs must be non-empty powers of two: "
+                    f"{self.spec_rungs!r}"
+                )
 
 
 def pow2ceil(n: int) -> int:
@@ -208,7 +232,14 @@ def dispatch_keys(spec: LatticeSpec) -> Set[Key]:
             keys.add(("cow",))
         return keys
     keys: Set[Key] = {("deactivate",)}
-    keys |= {("decode", n) for n in spec.decode_rungs}
+    if spec.spec:
+        # graftspec: the decode ladder never dispatches — the verify
+        # rungs (and the draft-model ladder, when resident) stand in.
+        keys |= {("verify", kk) for kk in spec.spec_rungs}
+        if spec.spec_draft:
+            keys |= {("draft", kk) for kk in spec.spec_rungs}
+    else:
+        keys |= {("decode", n) for n in spec.decode_rungs}
     if spec.paged and spec.prefix:
         keys.add(("cow",))
 
@@ -298,7 +329,17 @@ def simulate_keys(spec: LatticeSpec) -> Set[Key]:
             keys.add(("ragged", spec.ragged_chunk))      # decode wave
         return keys
     keys: Set[Key] = {("deactivate",)}
-    keys |= {("decode", n) for n in spec.decode_rungs}
+    if spec.spec:
+        # Scenario walk: every boundary's decode leg is ONE verify wave
+        # at the rung the pilot currently flies — and the pilot's
+        # envelope is the whole ladder, so every rung is reachable
+        # (with its draft-model twin when one is resident).
+        for kk in spec.spec_rungs:
+            keys.add(("verify", kk))
+            if spec.spec_draft:
+                keys.add(("draft", kk))
+    else:
+        keys |= {("decode", n) for n in spec.decode_rungs}
     if spec.paged and spec.prefix:
         keys.add(("cow",))
 
@@ -359,6 +400,7 @@ def simulate_keys(spec: LatticeSpec) -> Set[Key]:
 _FAMILY_RANK = {
     "deactivate": 0, "admit": 1, "admit-prefix": 2, "admit-paged": 3,
     "seed-prefix": 4, "chunk": 5, "cow": 6, "decode": 7, "ragged": 8,
+    "draft": 9, "verify": 10,
 }
 
 
@@ -403,6 +445,21 @@ def grid() -> List[LatticeSpec]:
                                            | {c})),
                 prefill_chunk=c, token_budget=budget,
                 ragged=True, ragged_chunk=c,
+            ))
+    # graftspec: the verify/draft ladders replace the decode rungs —
+    # paged forced (spec's precondition), crossed with chunked prefill
+    # and draft-model residency.
+    for chunked, sdraft in itertools.product((False, True), repeat=2):
+        for buckets, smax, slots, ma, c, budget in shapes[:2]:
+            specs.append(LatticeSpec(
+                buckets=buckets, max_seq_len=smax, max_slots=slots,
+                max_admit=ma, decode_rungs=(4, 8), paged=True,
+                chunked=chunked, prefix=False, prefix_block=16,
+                chunk_buckets=tuple(sorted({min(b, c) for b in buckets}
+                                           | {c})) if chunked else (),
+                prefill_chunk=c if chunked else 0,
+                token_budget=budget if chunked else 0,
+                spec=True, spec_rungs=(1, 2, 4), spec_draft=sdraft,
             ))
     return specs
 

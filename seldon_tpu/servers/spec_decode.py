@@ -1,0 +1,111 @@
+"""graftspec — host-side draft proposal for speculative decoding.
+
+Two drafters share one contract — propose k deterministic tokens per
+live slot from the row's full token history (prompt + generated) —
+and the engine picks at init:
+
+ * ``NGramDrafter`` (default, no second checkpoint): longest-suffix
+   n-gram match over the row's own history, proposing the tokens that
+   followed the previous occurrence. Zero device dispatches, zero HBM,
+   and surprisingly strong on the repetitive/templated traffic where
+   speculation pays most; on incompressible streams it degrades to
+   acceptance ~0 and the engine decodes at plain speed + one wide
+   verify's overhead (docs/benchmarking.md "when spec loses").
+ * ``ModelDrafter`` (``spec_draft`` names a checkpoint preset): the
+   resident small model proposes greedy continuations of a sliding
+   history window in one jitted dispatch per wave
+   (models/spec_decode.draft_tokens) — one compile per k rung, keyed
+   ``("draft", k)`` in the shape lattice.
+
+Determinism is the only correctness requirement here: verification is
+exact-match against the target's own sequentially-keyed samples, so a
+bad draft costs acceptance, never output fidelity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+# Longest n-gram pattern tried first; short windows keep the host-side
+# match O(SPEC_NGRAM_WINDOW * SPEC_NGRAM_MAX) per row per wave.
+NGRAM_MAX = 3
+# Only the trailing window of history is searched for a match — spec
+# waves run per boundary, so the drafter must stay far cheaper than
+# the dispatch it feeds.
+NGRAM_WINDOW = 256
+
+
+class NGramDrafter:
+    """Deterministic self-speculation: propose the continuation of the
+    most recent previous occurrence of the history's suffix n-gram
+    (n = NGRAM_MAX down to 1), falling back to repeating the last
+    token. Pure host arithmetic — no device work, no state."""
+
+    # Engine-facing capability flag: no jitted draft family to warm.
+    uses_model = False
+
+    def draft(self, prompt: Sequence[int], gen: Sequence[int],
+              k: int) -> List[int]:
+        hist = list(prompt[-NGRAM_WINDOW:]) + list(gen[-NGRAM_WINDOW:])
+        hist = hist[-NGRAM_WINDOW:]
+        L = len(hist)
+        for n in range(min(NGRAM_MAX, L - 1), 0, -1):
+            pat = hist[-n:]
+            # Rightmost earlier occurrence: continuation tokens exist
+            # by construction (j + n < L).
+            for j in range(L - n - 1, -1, -1):
+                if hist[j:j + n] == pat:
+                    cont = hist[j + n:j + n + k]
+                    while len(cont) < k:
+                        cont.append(cont[-1])
+                    return cont
+        return [hist[-1]] * k
+
+
+class ModelDrafter:
+    """Draft-model proposal through the engine's jitted
+    ``("draft", k)`` variants. The engine owns the jit dict (it builds
+    one per spec rung at init and warms them with the lattice); this
+    class owns window assembly and the host round trip."""
+
+    uses_model = True
+
+    def __init__(self, jit_by_k, window: int, pad_id: int):
+        self._jit_by_k = jit_by_k
+        self.window = int(window)
+        self._pad = int(pad_id)
+
+    def draft_batch(
+        self,
+        rows: Sequence[tuple],  # (slot, history list) pairs
+        k: int,
+        batch: int,
+    ) -> np.ndarray:
+        """One device dispatch proposing k tokens for every wave row.
+        Returns drafts [batch, k] int32 (non-wave rows stay pad)."""
+        import jax.numpy as jnp
+
+        W = self.window
+        window = np.full((batch, W), self._pad, np.int32)
+        wlens = np.ones((batch,), np.int32)
+        for slot, hist in rows:
+            tail = hist[-W:]
+            window[slot, :len(tail)] = tail
+            wlens[slot] = max(1, len(tail))
+        out = self._jit_by_k[k](jnp.asarray(window), jnp.asarray(wlens))
+        return np.asarray(out)
+
+
+def make_drafter(
+    draft_jits: Optional[Any],
+    window: int,
+    pad_id: int,
+):
+    """Engine factory: a ModelDrafter when the draft-model jit ladder
+    exists (EngineConfig.spec_draft named a checkpoint), else the
+    n-gram drafter."""
+    if draft_jits:
+        return ModelDrafter(draft_jits, window, pad_id)
+    return NGramDrafter()

@@ -51,6 +51,7 @@ SCHED_TOP_KEYS = frozenset({
     "useful_tokens",
     "bucket_pad_tokens",
     "group_pad_tokens",
+    "spec_rejected_tokens",
     "frag_tokens",
     "budget_offered_tokens",
     "budget_used_tokens",
@@ -62,12 +63,18 @@ SCHED_TOP_KEYS = frozenset({
     "pool_stall_requests",
     "preemptions",
     "preempted_tokens",
+    "spec",
     "wait",
     "conservation",
     "by_shape",
 })
 SCHED_GAP_KEYS = frozenset({
-    "bucket_pad_frac", "group_pad_frac", "frag_frac", "idle_frac",
+    "bucket_pad_frac", "group_pad_frac", "spec_rejected_frac",
+    "frag_frac", "idle_frac",
+})
+SCHED_SPEC_KEYS = frozenset({
+    "drafted_tokens", "accepted_tokens", "rejected_tokens",
+    "verify_waves", "acceptance_rate",
 })
 SCHED_WAIT_KEYS = frozenset({
     "requests", "total_ms", "pool_ms", "bucket_ms", "budget_ms",
@@ -76,7 +83,7 @@ SCHED_WAIT_KEYS = frozenset({
 SCHED_CONSERVATION_KEYS = frozenset({"checked", "breaches", "last_breach"})
 SCHED_SHAPE_KEYS = frozenset({
     "key", "dispatches", "cells", "useful_tokens", "bucket_pad_tokens",
-    "group_pad_tokens",
+    "group_pad_tokens", "spec_rejected_tokens",
 })
 
 # The documented /debug/pilot schema, frozen (tools/pilot_audit.py
@@ -96,11 +103,11 @@ PILOT_TOP_KEYS = frozenset({
     "ledger",
 })
 PILOT_KNOB_KEYS = frozenset({
-    "dispatch_token_budget", "max_admit", "chunk_bias",
+    "dispatch_token_budget", "max_admit", "chunk_bias", "spec_k",
 })
 PILOT_ENVELOPE_KEYS = frozenset({
     "budget_min", "budget_max", "admit_min", "admit_max", "bias_min",
-    "bias_max",
+    "bias_max", "speck_min", "speck_max",
 })
 PILOT_EDF_KEYS = frozenset({"inversions", "reorders", "expired_at_pop"})
 PILOT_CF_KEYS = frozenset({"windows", "goodput_delta", "waste_frac_delta"})
@@ -113,8 +120,8 @@ PILOT_SIGNAL_KEYS = frozenset({
     "boundaries", "dispatch_cells", "useful_tokens", "frag_tokens",
     "budget_dispatches", "budget_starved_passes",
     "budget_offered_tokens", "budget_used_tokens", "pool_stall_events",
-    "preemptions", "deadline_expired", "goodput", "queue_depth",
-    "free_slots",
+    "preemptions", "deadline_expired", "spec_drafted", "spec_accepted",
+    "goodput", "queue_depth", "free_slots",
 })
 
 
@@ -154,6 +161,10 @@ def _populated_sched_ledger() -> SchedLedger:
     # A graftragged wave: cells == useful by construction (exact-length
     # segments, no bucket rounding, no group replication).
     led.note_group(("ragged", 8), 46, 46, 0, 0)
+    # A graftspec verify wave: 2 rows x (k=4 drafts + 1) = 10 cells, 7
+    # emitted tokens -> 5 accepted drafts, 3 rejected positions.
+    led.note_group(("verify", 4), 10, 7, 0, 0, spec_rejected=3)
+    led.note_spec(8, 5, 3)
     led.note_budget(512, 400, starved=True)
     led.note_pool_stall(7)
     led.note_bucket_defer(7)
@@ -175,7 +186,7 @@ def _populated_pilot() -> PilotController:
 
     pilot = PilotController()
     pilot.bind(chunked=True, prefill_chunk=8, max_slots=4, max_admit=4,
-               dispatch_token_budget=8)
+               dispatch_token_budget=8, spec=True, spec_rungs=(1, 2, 4))
     now = time.perf_counter()
     pilot.order_queue(_c.deque([
         _t.SimpleNamespace(deadline=now + 9.0, submitted_at=now),
@@ -192,7 +203,8 @@ def _populated_pilot() -> PilotController:
         "frag_tokens": 0, "budget_dispatches": 0,
         "budget_starved_passes": 0, "budget_offered_tokens": 0,
         "budget_used_tokens": 0, "pool_stall_events": 0,
-        "preemptions": 0, "deadline_expired": 0, "goodput": 1.0,
+        "preemptions": 0, "deadline_expired": 0, "spec_drafted": 0,
+        "spec_accepted": 0, "goodput": 1.0,
         "queue_depth": 0, "free_slots": 4,
     }
     _windows(base)  # window 1 only baselines
@@ -265,6 +277,7 @@ def test_sched_snapshot_key_set_is_frozen():
     snap = _populated_sched_ledger().snapshot()
     assert set(snap) == SCHED_TOP_KEYS
     assert set(snap["goodput_gap"]) == SCHED_GAP_KEYS
+    assert set(snap["spec"]) == SCHED_SPEC_KEYS
     assert set(snap["wait"]) == SCHED_WAIT_KEYS
     assert set(snap["conservation"]) == SCHED_CONSERVATION_KEYS
     assert snap["by_shape"], "fixture must produce shape entries"
@@ -287,9 +300,17 @@ def test_sched_snapshot_value_kinds():
     assert snap["conservation"]["checked"] == 1
     assert snap["conservation"]["breaches"] == 0
     assert snap["conservation"]["last_breach"] is None
-    # Conservation restated from the snapshot itself.
+    # Conservation restated from the snapshot itself — the four-way
+    # split (graftspec adds rejected draft positions).
     assert (snap["useful_tokens"] + snap["bucket_pad_tokens"]
-            + snap["group_pad_tokens"]) == snap["dispatch_cells"]
+            + snap["group_pad_tokens"]
+            + snap["spec_rejected_tokens"]) == snap["dispatch_cells"]
+    # graftspec acceptance identity restated from the snapshot.
+    spec = snap["spec"]
+    assert (spec["accepted_tokens"] + spec["rejected_tokens"]
+            == spec["drafted_tokens"])
+    assert spec["verify_waves"] == 1
+    assert isinstance(spec["acceptance_rate"], float)
     for entry in snap["by_shape"]:
         # Keys render as the canonical slash-joined string, not tuples.
         assert isinstance(entry["key"], str) and "/" in entry["key"]
@@ -308,8 +329,11 @@ def test_sched_snapshot_empty_ledger_same_keys():
     snap = SchedLedger().snapshot()
     assert set(snap) == SCHED_TOP_KEYS
     assert set(snap["goodput_gap"]) == SCHED_GAP_KEYS
+    assert set(snap["spec"]) == SCHED_SPEC_KEYS
     assert set(snap["wait"]) == SCHED_WAIT_KEYS
     assert snap["by_shape"] == []
+    assert snap["spec"]["drafted_tokens"] == 0
+    assert snap["spec"]["acceptance_rate"] == 1.0
     assert snap["dispatch_cells"] == 0
     assert snap["padding_waste_frac"] == 0.0
     assert snap["budget_utilization"] == 1.0
@@ -362,6 +386,7 @@ def test_pilot_snapshot_value_kinds():
         <= env["budget_max"]
     assert env["admit_min"] <= knobs["max_admit"] <= env["admit_max"]
     assert env["bias_min"] <= knobs["chunk_bias"] <= env["bias_max"]
+    assert env["speck_min"] <= knobs["spec_k"] <= env["speck_max"]
 
 
 def test_pilot_snapshot_empty_controller_same_keys():

@@ -17,8 +17,11 @@ and compared base -> candidate with a direction heuristic:
    lost-capacity fractions — checked before the ``goodput`` substring
    would claim them as higher-is-better);
  * higher-is-better: names containing ``req_per_s``, ``req_s``,
-   ``tokens_per_s``, ``speedup``, ``hit_rate``, ``goodput``,
-   ``coverage``, plus the headline ``value`` / ``vs_baseline``;
+   ``tokens_per_s``, ``tok_s``, ``speedup``, ``hit_rate``, ``goodput``,
+   ``coverage``, ``acceptance_rate`` (graftspec: a better drafter keeps
+   more of every verify wave), plus the headline ``value`` /
+   ``vs_baseline``; the exact leaf ``dispatch_per_token`` gates
+   lower-is-better (verify waves compress the decode loop);
  * strict:           ``live_retraces`` and ``compile_variants`` — any
    increase over base fails regardless of tolerance (a retrace storm
    is a correctness-of-the-lattice bug, and the variant count is an
@@ -48,14 +51,16 @@ from typing import Any, Dict, List, Optional, Tuple
 # so "detail.chunked.p50_ttft_ms" gates on "p50_ttft_ms".
 _LOWER = ("ms", "latency", "stall", "frag", "dropped", "error",
           "inversions")
-_HIGHER = ("req_per_s", "req_s", "tokens_per_s", "speedup", "hit_rate",
-           "goodput", "coverage")
+_HIGHER = ("req_per_s", "req_s", "tokens_per_s", "tok_s", "speedup",
+           "hit_rate", "goodput", "coverage", "acceptance_rate")
 # Exact leaf-name matches for the headline numbers.
 _HIGHER_EXACT = ("value", "vs_baseline")
 # Exact lower-is-better leaves, checked BEFORE the substring tables:
 # "goodput_gap" would otherwise match the higher-is-better "goodput"
 # substring, and "padding_waste_frac" matches nothing ("frac" != "frag").
-_LOWER_EXACT = ("padding_waste_frac", "goodput_gap")
+# "dispatch_per_token" is graftspec's compression metric — verify waves
+# emitting more tokens per dispatch push it DOWN.
+_LOWER_EXACT = ("padding_waste_frac", "goodput_gap", "dispatch_per_token")
 _STRICT = ("live_retraces", "compile_variants")
 
 

@@ -34,6 +34,13 @@ retraces. The ragged numbers ride the metric line
 (``ragged_compile_variants`` / ``ragged_live_retraces``) so
 ``bench_compare`` gates them strictly.
 
+A third, SPEC leg boots the same server under ``SPEC=1`` and asserts
+the graftspec lattice contract: the pow2 ``verify/k`` ladder replaces
+the ``decode/n`` chunk rungs (a verify wave dispatched, no decode
+variant did), every dispatched key stays inside ``static_lattice()``,
+and zero live retraces — speculation must not reopen the shape lattice
+graftflow closed.
+
 Run via ``make compile-audit`` (wired into ``make ci``); exits non-zero
 with a one-line diagnosis on the first failed check.
 """
@@ -282,6 +289,59 @@ def main(argv=None) -> int:
         )
     rsrv.engine.stop()
 
+    # --- SPEC leg: the verify ladder stays inside the lattice -----------
+    # graftspec replaces the decode-chunk rungs with the pow2
+    # ("verify", k) ladder; the contract here is containment + zero
+    # retraces, not a fixed count (the admission grid is still live).
+    ssrv, sdetail, scomp, _, _ = _drive(spec=1)
+    _check(scomp["warmup_complete"],
+           "spec: warmup never sealed the lattice")
+    _check(
+        scomp["live_retrace_count"] == 0,
+        f"spec: {scomp['live_retrace_count']} live retraces after "
+        f"warmup: {scomp['live_retraces']}",
+    )
+    srogue = [e["key"] for e in scomp["lattice"] if not e["declared"]]
+    _check(not srogue, f"spec: undeclared lattice keys: {srogue}")
+    _check(
+        any(e["key"].startswith("verify/") for e in scomp["lattice"]),
+        f"spec: no verify/k variant dispatched "
+        f"(got: {sorted(e['key'] for e in scomp['lattice'])})",
+    )
+    _check(
+        not any(e["key"].startswith("decode/") for e in scomp["lattice"]),
+        "spec: a decode/ chunk variant dispatched — the verify ladder "
+        "should have replaced the decode rungs",
+    )
+    _check(
+        sdetail.get("compile_variants") == scomp["dispatched_variants"],
+        f"spec: ledger compile_variants "
+        f"{sdetail.get('compile_variants')} != /debug/compile "
+        f"{scomp['dispatched_variants']}",
+    )
+    spec_static_size = None
+    if args.static_xcheck:
+        sstatic = set(ssrv.engine.static_lattice())
+        spec_static_size = len(sstatic)
+        _check(
+            any(k.startswith("verify/") for k in sstatic),
+            f"spec: static lattice declares no verify family "
+            f"({sorted(sstatic)})",
+        )
+        sdispatched = {e["key"] for e in scomp["lattice"]}
+        sstray = sorted(sdispatched - sstatic)
+        _check(
+            not sstray,
+            f"spec: runtime dispatched {len(sstray)} key(s) outside "
+            f"the static lattice: {sstray}",
+        )
+        _check(
+            scomp["declared_variants"] == spec_static_size,
+            f"spec: warmup declared {scomp['declared_variants']} "
+            f"variants but the static lattice holds {spec_static_size}",
+        )
+    ssrv.engine.stop()
+
     print(json.dumps({
         "metric": "compile_audit",
         "value": 1,
@@ -301,6 +361,10 @@ def main(argv=None) -> int:
             "ragged_variant_budget": RAGGED_VARIANT_BUDGET,
             "ragged_live_retraces": rcomp["live_retrace_count"],
             "ragged_static_lattice": ragged_static_size,
+            "spec_requests": sdetail["requests"],
+            "spec_compile_variants": scomp["dispatched_variants"],
+            "spec_live_retraces": scomp["live_retrace_count"],
+            "spec_static_lattice": spec_static_size,
         },
     }))
     return 0

@@ -60,6 +60,23 @@ KNOBS = {
                        "wave's flat token buffer is max_slots * "
                        "ragged_chunk. Power of two, multiple of "
                        "kv_block."),
+    "SPEC": _k("engine-serving", "0",
+               "graftspec speculative decoding: a drafter proposes k "
+               "tokens per live decode row and ONE wide ragged verify "
+               "wave scores all k + 1 positions against the paged "
+               "block tables; exact-match acceptance keeps output "
+               "bit-identical to SPEC=0 at any temperature. Requires "
+               "paged_kv (forced on); incompatible with RAGGED."),
+    "SPEC_K": _k("engine-serving", "0 (engine default 4)",
+                 "Draft tokens per verify wave (power of two); the "
+                 "compiled pow2 verify ladder spans 1..spec_k and "
+                 "PILOT=1 auto-tunes the live rung from the windowed "
+                 "acceptance rate."),
+    "SPEC_DRAFT": _k("engine-serving", "(empty: host n-gram drafter)",
+                     "Draft model preset (e.g. `bench-1b` under an 8B "
+                     "target): loads a resident draft model and "
+                     "compiles the (\"draft\", k) ladder; empty uses "
+                     "the zero-cost host n-gram drafter."),
     "MAX_QUEUE": _k("engine-serving", "0 (unbounded)",
                     "Admission queue bound; past it submit() sheds with "
                     "a retriable 429 EngineOverloaded."),
@@ -229,6 +246,9 @@ KNOBS = {
     "MB_WINDOW": _k("bench-tools", "257", "Microbench KV window."),
     "MB_ACT": _k("bench-tools", "(follows weights)", "Microbench activation "
                  "dtype."),
+    "MB_DRAFT": _k("bench-tools", "(unset)", "Draft-model preset for the "
+                   "`--spec k` microbench mode; adds the draft dispatch "
+                   "to the wave cost."),
     "TUNE_ACT": _k("bench-tools", "int8", "Activation dtype for the 8b "
                    "tuning sweep."),
     "PROBE_PRESET": _k("bench-tools", "llama3-8b", "Slot-cliff probe preset "
@@ -315,6 +335,22 @@ KNOBS = {
                        "reporting req/s, padding_waste_frac, compile "
                        "variant count, and the measured speedup vs the "
                        "waste_roofline prediction."),
+    "BENCH_SPEC": _k("bench-harness", "0",
+                     "Run the speculative-decoding phase: the same "
+                     "greedy closed wave SPEC on vs off at equal "
+                     "hardware, asserting bit-identical streams and "
+                     "reporting per-leg decode tok/s, dispatches/token "
+                     "and the acceptance rate (bench_compare gates "
+                     "acceptance_rate higher-is-better and tok_s "
+                     "no-regression)."),
+    "BENCH_SPEC_K": _k("bench-harness", "4",
+                       "Draft tokens per verify wave in the spec "
+                       "phase."),
+    "BENCH_SPEC_DRAFT": _k("bench-harness", "self",
+                           "Spec phase drafter: `self` (target weights "
+                           "— the acceptance upper bound), empty for "
+                           "the host n-gram drafter, or a preset name "
+                           "for a resident draft model."),
     "BENCH_SLO": _k("bench-harness", "1 for bench-1b, else 0",
                     "Run the TTFT SLO search phase."),
     "BENCH_SLO_CHUNK": _k("bench-harness", "0 (adaptive)",

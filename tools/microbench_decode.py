@@ -3,8 +3,18 @@
 Times ONE jitted decode chunk (the engine's `_chunk_impl` equivalent:
 `decode_chunk` lax.scan steps over all slots) on the bench-1b serving
 shape, isolating the HBM-bound hot loop from scheduler/host effects.
-Usage: python tools/microbench_decode.py [combos...]
+Usage: python tools/microbench_decode.py [--spec k] [combos...]
   combo = weights:kv[:attn] e.g. int8:bf16  int8:int8  bf16:bf16
+
+``--spec k`` switches to the graftspec kernel pair: one paged verify
+wave over k drafts (models/spec_decode.verify_wave, Sq = k + 1 query
+rows) against the same wave at k = 0 — which IS a plain paged decode
+step through the identical code path, so the ratio isolates the extra
+width's cost. Prints the break-even emitted-tokens/wave (spec wins
+when mean acceptance clears it) and the full-acceptance speedup bound.
+``MB_DRAFT=<preset>`` additionally times the resident draft model's
+proposal dispatch (models/spec_decode.draft_tokens); without it the
+n-gram drafter's host cost (~0) is assumed.
 """
 
 from __future__ import annotations
@@ -109,8 +119,96 @@ def bench(weights: str, kv: str, attn: str = "xla") -> float:
     return ms_per_step
 
 
+def bench_spec(k: int, weights: str, kv: str, attn: str = "xla") -> None:
+    """graftspec kernel pair: verify wave at width k vs k = 0 (a plain
+    paged decode step through the same code path)."""
+    from seldon_tpu.models import spec_decode as spec_model
+
+    cfg = get_config(PRESET, weight_dtype=weights, kv_cache_dtype=kv,
+                     attn_impl=attn, act_dtype=act_for(weights))
+    if weights == "int8":
+        from seldon_tpu.models.quantize import init_params_int8
+
+        params = init_params_int8(cfg, jax.random.key(0))
+    else:
+        params = init_params(cfg, jax.random.key(0))
+    B = SLOTS
+    block = 64
+    nbs = -(-WINDOW // block)
+    # Block 0 is the trash block; row i owns blocks [1 + i*nbs, ...).
+    table = jnp.arange(1, B * nbs + 1, dtype=jnp.int32).reshape(B, nbs)
+    wave = jnp.ones((B,), jnp.bool_)
+
+    from tools.timing import slope_time
+
+    def time_width(kk: int) -> float:
+        # Fresh pool per width: the donated state dies with its timing.
+        state = {
+            "cache": transformer.init_paged_cache(cfg, B * nbs + 1, block),
+            "last_tok": jnp.ones((B,), jnp.int32),
+            "pos": jnp.full((B,), 128, jnp.int32),
+            "active": jnp.ones((B,), jnp.bool_),
+            "remaining": jnp.full((B,), 64, jnp.int32),
+            "temp": jnp.zeros((B,), jnp.float32),
+            "top_k": jnp.zeros((B,), jnp.int32),
+            "top_p": jnp.ones((B,), jnp.float32),
+            "seeds": jnp.arange(B, dtype=jnp.uint32),
+        }
+        drafts = jnp.ones((B, kk), jnp.int32)
+        fn = jax.jit(functools.partial(spec_model.verify_wave, cfg=cfg),
+                     donate_argnums=(1,))
+
+        def one(st):
+            st = dict(st, pos=jnp.full((B,), 128, jnp.int32),
+                      remaining=jnp.full((B,), 64, jnp.int32),
+                      active=jnp.ones((B,), jnp.bool_))
+            st, _, _ = fn(params, st, table, drafts, wave)
+            return st
+
+        dt, _ = slope_time(one, state, k1=2, k2=6)
+        return 1000.0 * dt
+
+    ms_plain = time_width(0)
+    ms_verify = time_width(k)
+    draft_ms = 0.0
+    draft_preset = os.environ.get("MB_DRAFT", "")
+    if draft_preset:
+        dcfg = get_config(draft_preset, act_dtype="bf16")
+        dparams = init_params(dcfg, jax.random.key(1))
+        W = 64
+        dfn = jax.jit(functools.partial(
+            spec_model.draft_tokens, dparams, cfg=dcfg, k=k))
+        window = jnp.ones((B, W), jnp.int32)
+        wlens = jnp.full((B,), W, jnp.int32)
+        dt, _ = slope_time(lambda s: (dfn(window, wlens), s)[1],
+                           state, k1=2, k2=6)
+        draft_ms = 1000.0 * dt
+    wave_ms = ms_verify + draft_ms
+    # Spec emits E tokens/wave; plain emits 1/dispatch. Break-even when
+    # wave_ms / E == ms_plain.
+    break_even = wave_ms / ms_plain
+    speedup_full = (k + 1) * ms_plain / wave_ms
+    print(
+        f"w={weights:5s} kv={kv:5s} act={cfg.act_dtype:5s} spec k={k} "
+        f"plain {ms_plain:7.3f} ms/step  verify {ms_verify:7.3f} ms/wave"
+        + (f"  draft {draft_ms:7.3f} ms/wave" if draft_preset else "")
+        + f"  break-even {break_even:.2f} tok/wave"
+        f"  full-accept speedup {speedup_full:.2f}x",
+        flush=True,
+    )
+
+
 if __name__ == "__main__":
-    combos = sys.argv[1:] or ["int8:bf16", "int8:int8", "bf16:bf16", "bf16:int8"]
+    args = sys.argv[1:]
+    spec_k = 0
+    if "--spec" in args:
+        i = args.index("--spec")
+        spec_k = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
+    combos = args or ["int8:bf16", "int8:int8", "bf16:bf16", "bf16:int8"]
     for c in combos:
         parts = c.split(":")
-        bench(*parts[:3])
+        if spec_k:
+            bench_spec(spec_k, *parts[:3])
+        else:
+            bench(*parts[:3])

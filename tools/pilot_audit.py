@@ -76,6 +76,11 @@ def _knobs_in_envelope(pilot: dict) -> None:
         f"chunk_bias {knobs['chunk_bias']} left the envelope "
         f"[{env['bias_min']}, {env['bias_max']}]",
     )
+    _check(
+        env["speck_min"] <= knobs["spec_k"] <= env["speck_max"],
+        f"spec_k {knobs['spec_k']} left the envelope "
+        f"[{env['speck_min']}, {env['speck_max']}]",
+    )
 
 
 def main(argv=None) -> int:

@@ -9,8 +9,9 @@ then asserts the observatory contract in one pass:
  * idle engine -> ZERO attribution: no dispatch cells, no useful or
    pad tokens, no wait decomposition — only idle boundaries tick;
  * after load, ``/debug/sched`` returns the documented schema and the
-   conservation invariant holds: useful + bucket-pad + group-pad
-   tokens re-sum to the dispatched cells within 1% (the ledger's own
+   conservation invariant holds: useful + bucket-pad + group-pad +
+   spec-rejected tokens re-sum to the dispatched cells within 1% (the
+   ledger's own
    ``audit()`` — run under ``_book`` at every boundary — must report
    zero breaches, and this script recomputes the sum independently);
  * the queue-wait components (pool / bucket / budget / sched) re-sum
@@ -42,9 +43,10 @@ import sys
 SCHED_TOP_KEYS = frozenset({
     "boundaries", "dispatch_boundaries", "idle_boundaries",
     "dispatch_cells", "useful_tokens", "bucket_pad_tokens",
-    "group_pad_tokens", "frag_tokens", "budget_offered_tokens",
-    "budget_used_tokens", "budget_starved_passes", "padding_waste_frac",
-    "budget_utilization", "goodput_gap", "pool_stall_events",
+    "group_pad_tokens", "spec_rejected_tokens", "frag_tokens",
+    "budget_offered_tokens", "budget_used_tokens",
+    "budget_starved_passes", "padding_waste_frac",
+    "budget_utilization", "goodput_gap", "spec", "pool_stall_events",
     "pool_stall_requests", "preemptions", "preempted_tokens", "wait",
     "conservation", "by_shape",
 })
@@ -142,7 +144,8 @@ def main(argv=None) -> int:
     )
     cells = sched["dispatch_cells"]
     attributed = (sched["useful_tokens"] + sched["bucket_pad_tokens"]
-                  + sched["group_pad_tokens"])
+                  + sched["group_pad_tokens"]
+                  + sched["spec_rejected_tokens"])
     _check(cells > 0, "no cells dispatched under load")
     _check(
         abs(attributed - cells) <= max(1, cells // 100),
@@ -178,7 +181,7 @@ def main(argv=None) -> int:
     )
     gap = sched["goodput_gap"]
     route_gap = round(gap["bucket_pad_frac"] + gap["group_pad_frac"]
-                      + gap["frag_frac"], 6)
+                      + gap["spec_rejected_frac"] + gap["frag_frac"], 6)
     _check(
         detail.get("goodput_gap") == route_gap,
         f"ledger goodput_gap {detail.get('goodput_gap')} != "

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""CI spec audit: graftspec speculative decoding end to end.
+
+Boots the tiny warmed JAXServer twice — once plain, once with
+``SPEC=1`` — plus ``GRAFTSAN=1`` + ``SCHED_LEDGER=1`` +
+``COMPILE_LEDGER=1`` + ``FLIGHT_RECORDER=1``, and asserts the
+speculation contract in one pass:
+
+ * BIT-EXACT PARITY: the spec engine reproduces the plain engine's
+   greedy streams token for token on a mixed-length prompt matrix —
+   speculation may only change how many dispatches a token costs,
+   never which token lands;
+ * the verify ladder is DECLARED: ``static_lattice()`` carries the
+   pow2 ``verify/k`` family, every dispatched variant is inside the
+   static set, and the compile ledger reports ZERO live retraces under
+   a real loadtester window (speculation must not reopen the shape
+   lattice graftflow closed);
+ * the books re-sum while speculating: the sched ledger's spec
+   accounting satisfies accepted + rejected == drafted, the
+   acceptance rate is the ratio of those counters, the four-way
+   conservation audit (useful + bucket pad + group pad +
+   spec-rejected == dispatched cells) reports zero breaches, and the
+   runtime sanitizer reports zero lock-contract violations;
+ * the surfaces agree: ``/debug/sched`` carries the spec sub-report,
+   the loadtester ledger mirrors its acceptance rate, the jaxserver
+   Prometheus surface exports the ``jaxserver_spec_*`` gauges, and
+   ``tools/trace_view.py`` renders the verify waves as their own
+   variant lanes in the flight-recorder timeline.
+
+Run via ``make spec-audit`` (wired into ``make ci``); exits non-zero
+with a one-line diagnosis on the first failed check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+# Mixed-length greedy parity matrix: lengths straddle the tiny server's
+# prompt buckets so admission grouping, chunked tails and block-table
+# growth all get exercised under speculation.
+PARITY_PROMPTS = [
+    list(range(2, 2 + n)) for n in (4, 11, 24, 17)
+]
+PARITY_NEW = 12
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        print(f"spec-audit FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _streams(engine) -> list:
+    """Greedy token streams for the parity matrix, in submit order."""
+    from seldon_tpu.models.sampling import SamplingParams
+
+    qs = [engine.submit(p, SamplingParams(
+              temperature=0.0, top_k=0, top_p=1.0,
+              max_new_tokens=PARITY_NEW, seed=i))
+          for i, p in enumerate(PARITY_PROMPTS)]
+    out = []
+    for q in qs:
+        toks = []
+        while True:
+            item = q.get(timeout=120)
+            if item is None:
+                break
+            if "error" in item:
+                raise RuntimeError(item["error"])
+            toks.extend(item.get("tokens", []))
+        out.append(toks)
+    return out
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["SPEC"] = "1"
+    os.environ["GRAFTSAN"] = "1"
+    os.environ["SCHED_LEDGER"] = "1"
+    os.environ["COMPILE_LEDGER"] = "1"
+    os.environ["FLIGHT_RECORDER"] = "1"
+    os.environ["DISPATCH_TIMING"] = "1"  # verify lanes in the timeline
+
+    import asyncio
+    import threading
+    import urllib.request
+
+    from aiohttp import web
+
+    from seldon_tpu.loadtester import main as lt_main
+    from seldon_tpu.runtime.wrapper import build_rest_app
+    from seldon_tpu.servers.jaxserver import JAXServer
+    from tools import trace_view
+
+    # --- reference leg: the same weights with speculation off ----------
+    # (spec=0 overrides the SPEC=1 env; init_seed-determined weights are
+    # identical across the two boots.)
+    ref = JAXServer(preset="tiny", max_slots=4, max_seq_len=64,
+                    warmup=1, spec=0)
+    ref.load()
+    ref.engine.start()
+    want = _streams(ref.engine)
+    ref.engine.stop()
+    del ref
+    _check(all(len(s) >= 1 for s in want),
+           "reference engine produced an empty stream")
+
+    # --- audited leg: SPEC=1 through the real REST app ------------------
+    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=64, warmup=1)
+    srv.load()
+    _check(srv.spec, "SPEC=1 did not arm the jaxserver spec path")
+
+    holder, started = {}, threading.Event()
+
+    async def amain() -> None:
+        runner = web.AppRunner(build_rest_app(srv))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    _check(started.wait(60), "REST app failed to start within 60s")
+    url = f"http://127.0.0.1:{holder['port']}"
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(url + path, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # --- bit-exact parity ------------------------------------------
+        got = _streams(srv.engine)
+        _check(
+            got == want,
+            "spec engine diverged from the plain greedy streams: "
+            f"want {want} got {got}",
+        )
+
+        # --- loadtester window under speculation ------------------------
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            lt_main([
+                url, "--transport", "generate", "--clients", "4",
+                "--seconds", "2", "--prompt", "hi",
+                "--max-new-tokens", "8",
+            ])
+        ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
+        detail = ledger["detail"]
+        _check(detail["errors"] == 0,
+               f"loadtester saw {detail['errors']} transport errors")
+        _check(detail["requests"] >= 1, "loadtester completed no requests")
+
+        sched = get("/debug/sched")
+        comp = get("/debug/compile")
+        snap = get("/debug/timeline")
+    finally:
+        holder["stop"] = True
+        t.join(timeout=10)
+
+    # --- lattice stays closed under speculation -------------------------
+    static = set(srv.engine.static_lattice())
+    _check(any(k.startswith("verify/") for k in static),
+           f"static lattice declares no verify family: {sorted(static)}")
+    dispatched = {row["key"] for row in comp["lattice"]}
+    _check(dispatched <= static,
+           f"dispatched variants escaped the static lattice: "
+           f"{sorted(dispatched - static)}")
+    _check(comp["live_retrace_count"] == 0,
+           f"{comp['live_retrace_count']} live retraces under SPEC=1")
+    _check(any(row["key"].startswith("verify/") for row in comp["lattice"]),
+           "no verify wave was ever dispatched")
+
+    # --- spec books re-sum ----------------------------------------------
+    spec = sched["spec"]
+    _check(spec["verify_waves"] > 0, "sched ledger counted no verify waves")
+    _check(spec["drafted_tokens"] > 0, "sched ledger counted no drafts")
+    _check(
+        spec["accepted_tokens"] + spec["rejected_tokens"]
+        == spec["drafted_tokens"],
+        f"acceptance identity broken: {spec}",
+    )
+    _check(
+        abs(spec["acceptance_rate"]
+            - spec["accepted_tokens"] / spec["drafted_tokens"]) < 1e-6,
+        f"acceptance_rate does not re-derive: {spec}",  # snapshot rounds
+    )
+    cells = sched["dispatch_cells"]
+    attributed = (sched["useful_tokens"] + sched["bucket_pad_tokens"]
+                  + sched["group_pad_tokens"]
+                  + sched["spec_rejected_tokens"])
+    _check(attributed == cells,
+           f"4-way attribution {attributed} != dispatched cells {cells}")
+    cons = sched["conservation"]
+    _check(cons["checked"] > 0, "conservation audit never ran")
+    _check(cons["breaches"] == 0,
+           f"{cons['breaches']} conservation breaches while speculating: "
+           f"{cons['last_breach']}")
+    san = srv.engine._san
+    _check(san is not None, "GRAFTSAN=1 but the engine has no sanitizer")
+    _check(not san.violations,
+           f"graftsan violations while speculating: {san.violations}")
+
+    # --- surface parity (counters static once the load window closed) ---
+    _check(
+        detail.get("spec_acceptance_rate") == spec["acceptance_rate"],
+        f"ledger spec_acceptance_rate {detail.get('spec_acceptance_rate')} "
+        f"!= /debug/sched {spec['acceptance_rate']}",
+    )
+    gauges = {m["key"] for m in srv.metrics()}
+    for key in ("jaxserver_spec_acceptance_rate",
+                "jaxserver_spec_drafted_tokens",
+                "jaxserver_spec_accepted_tokens",
+                "jaxserver_spec_rejected_tokens",
+                "jaxserver_spec_verify_waves"):
+        _check(key in gauges, f"metrics() missing gauge {key}")
+
+    # --- flight recorder + trace_view verify lanes -----------------------
+    waves = [r for r in snap.get("records", [])
+             if r["kind"] == "dispatch"
+             and str((r.get("detail") or {}).get("variant", ""))
+             .startswith("verify/")]
+    _check(waves, "no verify-wave dispatch records in the timeline")
+    _check(any("verify_k" in (r.get("detail") or {})
+               for r in snap.get("records", [])
+               if r["kind"] == "boundary"),
+           "spec boundary records carry no verify_k acceptance detail")
+    out = json.loads(json.dumps(trace_view.convert(snap)))
+    lanes = {e["args"]["name"] for e in out["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    _check(any(name.startswith("verify/") for name in lanes),
+           f"trace_view rendered no verify variant lane (got {lanes})")
+    counters = {e["name"] for e in out["traceEvents"] if e["ph"] == "C"}
+    _check("spec_accepted_tokens" in counters,
+           f"trace_view rendered no spec acceptance counter "
+           f"(got {counters})")
+
+    srv.engine.stop()
+
+    print(json.dumps({
+        "metric": "spec_audit",
+        "value": 1,
+        "detail": {
+            "requests": detail["requests"],
+            "parity_streams": len(want),
+            "verify_waves": spec["verify_waves"],
+            "drafted_tokens": spec["drafted_tokens"],
+            "accepted_tokens": spec["accepted_tokens"],
+            "acceptance_rate": round(spec["acceptance_rate"], 4),
+            "spec_rejected_tokens": sched["spec_rejected_tokens"],
+            "live_retraces": comp["live_retrace_count"],
+            "conservation_checked": cons["checked"],
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,14 @@ Rendering model:
  * "dispatch" records (DISPATCH_TIMING=1) become "X" slices on a
    second "variants" process — one lane per compile-ledger variant key
    ("admit/32/4", "decode/8", ...), spanning dispatch -> boundary so
-   per-variant device occupancy reads directly off the track;
+   per-variant device occupancy reads directly off the track; under
+   SPEC=1 the draft and verify waves land on their own "draft/k" /
+   "verify/k" lanes, so speculation's dispatch structure reads
+   directly against the plain decode lane it replaced;
+ * spec boundary records (SPEC=1, the ``verify_k``/``emitted``/
+   ``accepted``/``rejected`` detail) add a ``spec_accepted_tokens``
+   counter series — per-wave acceptance as a graph over the verify
+   lanes that earned it;
  * "retrace" records (COMPILE_LEDGER=1) are the live-retrace
    witnesses — rendered as instants on the paying request's track;
  * "pilot" records (PILOT=1) are the controller's decisions — rendered
@@ -189,6 +196,13 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                 events.append({
                     "ph": "C", "pid": 1, "name": "padding_waste_frac",
                     "ts": ts, "args": {"frac": detail["waste_frac"]},
+                })
+            if "verify_k" in detail:
+                events.append({
+                    "ph": "C", "pid": 1, "name": "spec_accepted_tokens",
+                    "ts": ts,
+                    "args": {"accepted": detail.get("accepted", 0),
+                             "rejected": detail.get("rejected", 0)},
                 })
         elif kind == "pilot":
             knob = detail.get("knob", "?")
