@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -121,6 +121,18 @@ pilot-audit:
 spec-audit:
 	env JAX_PLATFORMS=cpu python -m tools.spec_audit
 
+# Roofline observatory gate (docs/benchmarking.md "Reading the
+# roofline"): warmed tiny server + loadtester with ROOF_LEDGER +
+# FLIGHT_RECORDER on — asserts the /debug index lists every surface,
+# zero attribution on the idle engine, per-variant mfu/mbu in [0, 1]
+# with sane compute/bandwidth/host bound labels, the step-decomposition
+# conservation invariant (host-pre + device + host-post + overlap
+# re-sum to the boundary wall within 1%), predicted-vs-measured inside
+# a generous CPU band, loadtester/route parity, the jaxserver_mfu/mbu/
+# host_frac gauges, and the trace_view host/device lanes.
+roof-audit:
+	env JAX_PLATFORMS=cpu python -m tools.roof_audit
+
 bench:
 	python bench.py
 
@@ -132,7 +144,7 @@ bench-compare:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit
+ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

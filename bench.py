@@ -727,6 +727,35 @@ def _sched_counts(engine, req_s: float = 0.0) -> dict:
     return out
 
 
+def _roof_counts(engine, req_s: float = 0.0, prompt_len: int = 0,
+                 max_new: int = 0) -> dict:
+    """Roofline section for a phase detail dict (ROOF_LEDGER=1 is the
+    bench default): achieved mfu/mbu against the platform peaks
+    (higher is better, gated by tools/bench_compare.py), the host share
+    of boundary wall time (lower is better — a rising host_frac says
+    the scheduler, not the device, is the bottleneck), and — when the
+    phase supplies its workload shape — the measured-over-predicted
+    req/s ratio that reconciles _sched_counts' waste_roofline with
+    hardware efficiency. Empty when the ledger is off."""
+    snap = engine.debug_roof()
+    if snap is None:
+        return {}
+    out = {
+        "mfu": snap["totals"]["mfu"],
+        "mbu": snap["totals"]["mbu"],
+        "host_frac": snap["host_frac"],
+        "roof_conservation_breaches": snap["conservation"]["breaches"],
+    }
+    if req_s > 0.0 and prompt_len > 0:
+        est_ms = engine.roof_predict_ms(prompt_len, max_new)
+        if est_ms and est_ms > 0.0:
+            out["roof_predicted_req_s"] = round(1000.0 / est_ms, 2)
+            out["predicted_vs_measured_req_s"] = round(
+                req_s * est_ms / 1000.0, 4
+            )
+    return out
+
+
 def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
                         admit: int = 8):
     """Saturated closed-loop wave -> (req_s, detail dict, sp factory)."""
@@ -785,6 +814,8 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
     dt = time.perf_counter() - t0
     comp = _compile_counts(engine)
     sched = _sched_counts(engine, req_s=n_req / dt)
+    roof = _roof_counts(engine, req_s=n_req / dt,
+                        prompt_len=PROMPT_LEN, max_new=NEW_TOKENS)
     engine.stop()
 
     detail = {
@@ -795,6 +826,7 @@ def _measure_throughput(params, cfg, slots: int, n_req: int, chunk: int,
         "device": str(jax.devices()[0]),
         **comp,
         **sched,
+        **roof,
     }
     return n_req / dt, detail, sp
 
@@ -965,11 +997,13 @@ def _measure_chunked(params, cfg) -> dict:
         snap = engine.stats.snapshot()
         comp = _compile_counts(engine)
         sched = _sched_counts(engine)
+        roof = _roof_counts(engine)
         engine.stop()
         tail = [g for ts, g in gaps if ts >= t_long]
         run.last_snap = snap  # engine-side counters for the report
         run.last_comp = comp
         run.last_sched = sched
+        run.last_roof = roof
         return 1000.0 * float(np.percentile(tail or [0.0], 99))
 
     base_p99 = run(chunked=False)
@@ -978,6 +1012,7 @@ def _measure_chunked(params, cfg) -> dict:
     return {
         **run.last_comp,
         **run.last_sched,
+        **run.last_roof,
         "streams": CHUNKED_STREAMS,
         "long_prompt_tokens": long_len,
         "prefill_chunk": PROMPT_LEN,
@@ -1088,10 +1123,12 @@ def _measure_paged(params, cfg) -> dict:
     s1 = paged_eng.stats.snapshot()
     comp = _compile_counts(paged_eng)
     sched = _sched_counts(paged_eng)
+    roof = _roof_counts(paged_eng)
     paged_eng.stop()
     return {
         **comp,
         **sched,
+        **roof,
         "kv_block": bs,
         "kv_pool_blocks": pool_blocks + 1,
         "dense_slots": PAGED_DENSE_SLOTS,
@@ -1180,6 +1217,9 @@ def _measure_ragged(params, cfg) -> dict:
             "makespan_s": round(dt, 3),
             **_compile_counts(engine),
             **_sched_counts(engine, req_s=req_s),
+            **_roof_counts(engine, req_s=req_s,
+                           prompt_len=int(np.mean(lengths)),
+                           max_new=new_toks),
         }
         engine.stop()
         return out
@@ -1281,6 +1321,7 @@ def _measure_spec(params, cfg) -> dict:
             ),
             **_compile_counts(engine),
             **_sched_counts(engine),
+            **_roof_counts(engine),
         }
         engine.stop()
         return out, streams
@@ -1314,6 +1355,7 @@ def main() -> None:
     # auditable for retrace storms via tools/bench_compare.py.
     os.environ.setdefault("COMPILE_LEDGER", "1")
     os.environ.setdefault("SCHED_LEDGER", "1")
+    os.environ.setdefault("ROOF_LEDGER", "1")
 
     params, cfg = _build(PRESET)
     req_s, detail, sp = _measure_throughput(

@@ -452,6 +452,34 @@ def _pilot_counts(url: str) -> dict:
         return {}
 
 
+def _roof_counts(url: str) -> dict:
+    """Best-effort /debug/roof poll after a run: folds graftroof's
+    headline roofline numbers (achieved mfu/mbu, the host share of
+    boundary wall time, the conservation-audit breach count) into the
+    ledger. Empty when the server has no roof ledger (ROOF_LEDGER off
+    -> the route 404s)."""
+    import urllib.request
+    try:
+        # Same short-timeout rationale as _compile_counts above.
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/debug/roof", timeout=2
+        ) as resp:
+            roof = json.loads(resp.read())
+        return {
+            "mfu": float(roof["totals"]["mfu"]),
+            "mbu": float(roof["totals"]["mbu"]),
+            "host_frac": float(roof["host_frac"]),
+            "roof_conservation_breaches": int(
+                roof["conservation"]["breaches"]
+            ),
+        }
+    except (OSError, ValueError, KeyError) as exc:
+        logger.debug("loadtester: /debug/roof poll failed (%s: %s) — "
+                     "ledger carries no roofline counters",
+                     type(exc).__name__, exc)
+        return {}
+
+
 def report(transport: str, total: int, dt: float, latencies, errors: int,
            clients: int, extra: Optional[dict] = None) -> dict:
     lats = np.asarray(latencies) * 1000.0 if latencies else np.zeros(1)
@@ -551,6 +579,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         extra.update(_sched_counts(args.url))
         pilot = _pilot_counts(args.url)
         extra.update(pilot)
+        roof = _roof_counts(args.url)
+        extra.update(roof)
         report("generate", total, dt, lats, errors, args.clients,
                extra=extra)
         if pilot:
@@ -560,6 +590,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                 f"pilot: {pilot['pilot_decisions']} decisions, "
                 f"final knobs {pilot['pilot_knobs']}, "
                 f"{pilot['pilot_edf_inversions']} EDF inversions",
+                file=sys.stderr,
+            )
+        if roof:
+            # Roofline postscript: how hard the hardware ran and how
+            # much of each boundary the host ate.
+            print(
+                f"roof: mfu={roof['mfu']:.4f} mbu={roof['mbu']:.4f} "
+                f"host_frac={roof['host_frac']:.4f}",
                 file=sys.stderr,
             )
         return

@@ -421,6 +421,43 @@ def build_rest_app(
         "debug_pilot", "unit has no pilot controller",
         "pilot disabled (set PILOT=1)",
     ))
+    app.router.add_get("/debug/roof", _debug_route(
+        "debug_roof", "unit has no roof ledger",
+        "roof ledger disabled (set ROOF_LEDGER=1)",
+    ))
+
+    # Every observability surface with its arming knob, so operators
+    # stop probing /debug/* routes one 404 hint at a time. Kept in
+    # lock-step with the registrations above.
+    _DEBUG_SURFACES = (
+        ("/debug/timeline", "debug_timeline", "FLIGHT_RECORDER"),
+        ("/debug/compile", "debug_compile", "COMPILE_LEDGER"),
+        ("/debug/hbm", "debug_hbm", "HBM_LEDGER"),
+        ("/debug/sched", "debug_sched", "SCHED_LEDGER"),
+        ("/debug/pilot", "debug_pilot", "PILOT"),
+        ("/debug/roof", "debug_roof", "ROOF_LEDGER"),
+    )
+
+    async def handle_debug_index(request: web.Request) -> web.Response:
+        def probe() -> dict:
+            surfaces = []
+            for route, attr, knob in _DEBUG_SURFACES:
+                fn = getattr(user_obj, attr, None)
+                entry = {"route": route, "knob": knob,
+                         "supported": callable(fn), "armed": False}
+                if callable(fn):
+                    try:
+                        entry["armed"] = fn() is not None
+                    except Exception:  # a broken hook reads as unarmed
+                        entry["armed"] = False
+                surfaces.append(entry)
+            return {"surfaces": surfaces}
+
+        loop = asyncio.get_running_loop()
+        snap = await loop.run_in_executor(request.app["executor"], probe)
+        return web.json_response(snap)
+
+    app.router.add_get("/debug", handle_debug_index)
 
     app.router.add_get("/live", handle_live)
     app.router.add_get("/health/live", handle_live)

@@ -95,7 +95,9 @@ Concurrency contract (the compile-ledger discipline, applied again):
            "deadline_expired": int,
            "spec_drafted": int, "spec_accepted": int,
            "goodput": float,
-           "queue_depth": int, "free_slots": int},
+           "queue_depth": int, "free_slots": int,
+           "roof_backlog_ms": float},  # graftroof queue cost (0 when
+                                       # ROOF_LEDGER is off)
          "effect": null | {"goodput_delta": float,
                            "waste_frac_delta": float}},
         ...
@@ -161,8 +163,12 @@ _DELTA_KEYS = (
     "pool_stall_events", "preemptions", "deadline_expired",
     "spec_drafted", "spec_accepted",
 )
-# Instantaneous signals copied into the window as-is.
-_LEVEL_KEYS = ("goodput", "queue_depth", "free_slots")
+# Instantaneous signals copied into the window as-is. roof_backlog_ms
+# is the graftroof cost model's predicted service time of the queue
+# (0.0 whenever ROOF_LEDGER is off) — the level a cost-model tier
+# router conditions on.
+_LEVEL_KEYS = ("goodput", "queue_depth", "free_slots",
+               "roof_backlog_ms")
 
 
 def from_env() -> Optional["PilotController"]:

@@ -1,0 +1,663 @@
+"""graftroof: analytical cost model + MFU/MBU roofline ledger.
+
+The dispatch lattice's static keys ARE shapes (``shape_lattice.FAMILIES``
+— ("admit", 64, 4) is 4 rows of 64 prefill tokens, ("decode", 8) is 8
+steps over every slot), so the FLOPs and HBM bytes of every variant the
+engine can dispatch are closed-form host arithmetic over the model
+config. This module prices them:
+
+ * :func:`cost_of_key` — (flops, bytes) for ONE dispatch of any lattice
+   key, parameterized by the model config (layers/heads/dims/dtype
+   widths) and the engine geometry (slots, cache window, paged block,
+   ragged chunk). Formula conventions are documented per family below;
+   two deliberate ones up front: a dispatch reads the full weight
+   working set once (batched rows amortize it — the serving regime the
+   engine exists for), and the ragged wave is priced at its CAPACITY
+   ``max_slots * ragged_chunk`` (the static shape), so a lightly packed
+   wave reads as low MFU — the roofline's view of the same waste the
+   sched ledger attributes token-by-token.
+ * :func:`predict` — the per-request cost surface
+   ``predict(prompt_len, max_new, config) -> {flops, bytes, est_ms}``:
+   prefill plus every decode step at its growing context, weight reads
+   amortized over the slot count. This is the marginal-cost signal
+   Nitsum-style tier routing consumes (one request's resource-seconds),
+   and ``1000 / est_ms`` is its implied saturated req/s.
+ * :class:`RoofLedger` (``ROOF_LEDGER=1``; ``from_env`` -> None — and
+   zero hot-path cost — otherwise): joins the priced keys with the
+   measured per-variant dispatch timing (ROOF_LEDGER implies
+   DISPATCH_TIMING) into achieved FLOP/s and bytes/s per variant
+   against a per-platform peak table, classifying each variant
+   compute-bound / bandwidth-bound / host-bound, and decomposes every
+   scheduler boundary into host-pre / device / host-post / overlap wall
+   time with a sched-ledger-style conservation audit (components must
+   re-sum to the measured boundary span within 1%).
+
+Peak provenance (``snapshot()["peaks"]["source"]``):
+
+ * ``env`` — ``ROOF_PEAK_TFLOPS`` / ``ROOF_PEAK_GBS`` set by the
+   operator (either may individually override the table);
+ * ``table`` — the builtin per-platform entry matched against the JAX
+   ``device_kind`` string (bf16 peak dense TFLOPS and HBM GB/s from the
+   published TPU specs; W8A8 int8 runs the MXU at 2x this basis, so an
+   int8-serving MFU of ~0.5 is the practical ceiling — documented in
+   docs/benchmarking.md "Reading the roofline");
+ * ``microbench`` — unknown platform (CPU smoke runs): a one-shot
+   cached numpy matmul + memcpy calibration, run at ``bind()`` time
+   (engine init — cold path, never under ``_book``).
+
+Pure stdlib — no jax import, like ``shape_lattice`` — so lint and tools
+can load it anywhere; numpy for the calibration fallback is imported
+lazily inside the microbench and failure degrades to fixed conservative
+constants.
+
+Single-writer discipline (the sched-ledger idiom): every ``note_*`` /
+``audit`` mutator runs on the scheduler thread (or the fetcher) under
+``_book``; ``snapshot()`` reads GIL-atomic fields from any thread and
+may observe a torn WINDOW but never a torn record.
+
+``snapshot()`` — the documented /debug/roof schema, frozen by
+tests/test_debug_schema.py::ROOF_* goldens:
+
+    {
+      "enabled": True,
+      "platform": str,              # device_kind the peaks matched
+      "peaks": {"tflops": float, "gbs": float, "source": str},
+      "boundaries": int,            # dispatched boundaries decomposed
+      "waves": int,                 # note_wave joins (keys x timing)
+      "step": {                     # cumulative decomposition, ms
+        "wall_ms": float,           #   measured boundary span
+        "host_pre_ms": float,       #   scheduling under _book, ledger
+        "device_ms": float,         #   jit enqueue + boundary fetch
+        "host_post_ms": float,      #   post-fetch bookkeeping
+        "overlap_ms": float,        #   pipelined gap (other boundaries'
+      },                            #   host work ran here)
+      "host_frac": float,           # (pre + post) / wall
+      "device_frac": float,         # device / wall
+      "conservation": {"checked": int, "breaches": int,
+                       "last_breach": str | None},
+      "variants": [                 # per dispatch-key roofline, sorted
+        {"key": str,                #   compile-ledger spelling
+         "family": str,             #   first key segment
+         "dispatches": int,
+         "flops": float, "bytes": float,
+         "device_ms": float,        #   wave device time, est-weighted
+         "predicted_ms": float,     #   roofline est at the peak table
+         "mfu": float, "mbu": float,  # achieved/peak, clamped to 1.0
+         "bound": str}              #   compute | bandwidth | host
+      ],
+      "totals": {"dispatches": int, "flops": float, "bytes": float,
+                 "device_ms": float, "predicted_ms": float,
+                 "mfu": float, "mbu": float},
+    }
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from seldon_tpu.servers.compile_ledger import key_str
+from seldon_tpu.servers.shape_lattice import FAMILIES
+
+logger = logging.getLogger(__name__)
+
+Key = Tuple[Any, ...]
+
+# Matmul/embedding dtype widths (cfg.weight_dtype / kv_cache_dtype
+# spellings plus the cfg.dtype long form).
+_DTYPE_BYTES = {"bf16": 2, "bfloat16": 2, "int8": 1, "fp32": 4,
+                "float32": 4}
+
+# Published per-chip peaks: device_kind substring -> (dense bf16
+# TFLOPS, HBM GB/s). Matched longest-substring-first so "v5p" never
+# falls through to a bare "v5" entry. The bf16 basis is deliberate:
+# one stable denominator per chip (W8A8 doubles the MXU rate, so int8
+# runs top out near mfu 0.5 against it — see docs/benchmarking.md).
+_PEAK_TABLE = (
+    ("v6e", (918.0, 1640.0)),
+    ("trillium", (918.0, 1640.0)),
+    ("v5 lite", (197.0, 819.0)),
+    ("v5e", (197.0, 819.0)),
+    ("v5p", (459.0, 2765.0)),
+    ("v4", (275.0, 1228.0)),
+    ("v3", (123.0, 900.0)),
+    ("v2", (46.0, 700.0)),
+)
+# Conservative floor when even the numpy calibration is unavailable.
+_FALLBACK_PEAKS = (0.05, 5.0)
+
+# Per-variant table cap: past it, new keys fold into one overflow row
+# (the sched ledger's _MAX_SHAPES idiom) so the payload stays bounded.
+_MAX_VARIANTS = 128
+_OVERFLOW_KEY: Key = ("other",)
+# predict() memo cap (prompt_len, max_new) -> est_ms; cleared when full.
+_MAX_PREDICT_CACHE = 2048
+# Below this fraction of BOTH roofs a variant is not meaningfully using
+# the hardware at all — its wall time is host overhead, not the device.
+HOST_BOUND_FRAC = 0.1
+
+# One-shot microbench result, shared across ledgers in the process.
+_MICROBENCH_PEAKS: Optional[Tuple[float, float]] = None
+
+
+# -- model-config arithmetic (duck-typed on models.config.ModelConfig) ------
+
+
+def _wbytes(cfg) -> int:
+    return _DTYPE_BYTES.get(getattr(cfg, "weight_dtype", "bf16"), 2)
+
+
+def _kvbytes(cfg) -> int:
+    return _DTYPE_BYTES.get(getattr(cfg, "kv_cache_dtype", "bf16"), 2)
+
+
+def matmul_params_per_layer(cfg) -> int:
+    """Matmul weights one token multiplies through per layer: fused qkv
+    + o projections and the SwiGLU triple (per-token active experts
+    under MoE — the router's d*E is noise and ignored)."""
+    hd = cfg.d_model // cfg.n_heads
+    qkv = cfg.d_model * (cfg.n_heads * hd + 2 * cfg.n_kv_heads * hd)
+    o = cfg.d_model * cfg.d_model
+    mlp = 3 * cfg.d_model * cfg.d_ff
+    if getattr(cfg, "n_experts", 0):
+        mlp *= cfg.n_experts_per_token
+    return qkv + o + mlp
+
+
+def flops_per_token(cfg) -> int:
+    """Dense forward FLOPs per token EXCLUDING attention-over-context
+    (that term depends on the key's window — see attn_flops): 2 flops
+    per matmul parameter, lm_head included."""
+    return 2 * (cfg.n_layers * matmul_params_per_layer(cfg)
+                + cfg.d_model * cfg.vocab_size)
+
+
+def attn_flops(cfg, q_tokens: int, kv_len: int) -> int:
+    """Attention-over-context FLOPs: q_tokens query positions each
+    scoring + mixing kv_len cached positions across every layer — QK^T
+    and PV are 2 flops per (head, dim, position) each, and GQA shares
+    K/V without shrinking the query side: 4 * d_model * q * kv per
+    layer."""
+    return 4 * cfg.d_model * q_tokens * kv_len * cfg.n_layers
+
+
+def causal_attn_flops(cfg, s_tokens: int, prior: int = 0) -> int:
+    """Prefill attention: token i of a fresh s-token segment attends
+    prior + i + 1 positions — the arithmetic-series sum of attn_flops."""
+    total_kv = s_tokens * prior + s_tokens * (s_tokens + 1) // 2
+    return 4 * cfg.d_model * total_kv * cfg.n_layers
+
+
+def weight_bytes(cfg) -> int:
+    """HBM bytes of one full weight read: matmul weights at the
+    serving weight dtype (ALL experts under MoE — a batched wave
+    touches the lot), embeddings + lm_head at bf16 (they stay
+    unquantized, models/quantize.py)."""
+    mlp = 3 * cfg.d_model * cfg.d_ff
+    if getattr(cfg, "n_experts", 0):
+        mlp *= cfg.n_experts
+    hd = cfg.d_model // cfg.n_heads
+    per_layer = (cfg.d_model * (cfg.n_heads * hd + 2 * cfg.n_kv_heads * hd)
+                 + cfg.d_model * cfg.d_model + mlp)
+    emb = cfg.vocab_size * cfg.d_model * 2          # bf16 embedding
+    head = cfg.d_model * cfg.vocab_size * 2         # bf16 lm_head
+    return cfg.n_layers * per_layer * _wbytes(cfg) + emb + head
+
+
+def kv_bytes_per_token(cfg) -> int:
+    """KV-cache bytes one token position occupies across every layer:
+    K + V at the kv dtype, GQA heads only."""
+    hd = cfg.d_model // cfg.n_heads
+    return 2 * cfg.n_layers * cfg.n_kv_heads * hd * _kvbytes(cfg)
+
+
+# -- per-key closed forms ---------------------------------------------------
+
+
+def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
+                kv_block: int = 0, ragged_chunk: int = 0,
+                draft_cfg=None) -> Tuple[float, float]:
+    """(flops, hbm_bytes) for ONE dispatch of a lattice key. Covers
+    every family in shape_lattice.FAMILIES (pinned by
+    tests/test_cost_model.py); raises ValueError on an unknown tag so
+    a new dispatch family cannot silently price as zero.
+
+    Window convention: decode-side attention reads the full cache
+    window (dense kernels scan max_seq_len every step; paged tables
+    are priced at the same bound) — the serving-shape upper bound the
+    engine actually dispatches, not the request's live length."""
+    fam = key[0]
+    B, W = max_slots, max_seq_len
+    fpt = flops_per_token(cfg)
+    kvpt = kv_bytes_per_token(cfg)
+    wb = weight_bytes(cfg)
+    if fam == "deactivate":
+        # One masked write over the per-slot scalars — no matmuls.
+        return 0.0, float(B * 64)
+    if fam == "cow":
+        # One shared block copied read+write across every layer.
+        return 0.0, float(2 * kv_block * kvpt)
+    if fam == "seed-prefix":
+        # (tag, W): trie KV copied into the slot slab, read + write.
+        return 0.0, float(2 * key[1] * kvpt)
+    if fam == "admit":
+        # (tag, Sb, G): G rows prefill Sb tokens, causal attention.
+        sb, g = key[1], key[2]
+        flops = g * (sb * fpt + causal_attn_flops(cfg, sb))
+        return float(flops), float(wb + g * sb * kvpt)
+    if fam == "admit-prefix":
+        # (tag, Pb, Sb, G): suffix Sb computed over a Pb-token prefix
+        # already resident in the cache.
+        pb, sb, g = key[1], key[2], key[3]
+        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, prior=pb))
+        return float(flops), float(wb + g * (pb + sb) * kvpt)
+    if fam == "admit-paged":
+        # (tag, Sb, G, W): paged admission, prefix width W resident.
+        sb, g, pw = key[1], key[2], key[3]
+        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, prior=pw))
+        return float(flops), float(wb + g * (pw + sb) * kvpt)
+    if fam == "chunk":
+        # (tag, Sc, G, W): G rows advance Sc prefill tokens against a
+        # W-token resident view.
+        sc, g, rw = key[1], key[2], key[3]
+        flops = g * (sc * fpt + causal_attn_flops(cfg, sc, prior=rw))
+        return float(flops), float(wb + g * (rw + sc) * kvpt)
+    if fam == "decode":
+        # (tag, n): n sequential steps over every slot; every step
+        # re-reads the weights and the full cache window.
+        n = key[1]
+        flops = n * B * (fpt + attn_flops(cfg, 1, W) // 1)
+        bytes_ = n * (wb + B * W * kvpt + B * kvpt)
+        return float(flops), float(bytes_)
+    if fam == "ragged":
+        # (tag, C): ONE fused wave priced at its static capacity
+        # max_slots * C — low packing reads as low MFU by design.
+        c = key[1] or ragged_chunk
+        t = B * c
+        flops = t * fpt + attn_flops(cfg, t, W)
+        return float(flops), float(wb + B * W * kvpt + t * kvpt)
+    if fam == "verify":
+        # (tag, k): every armed row scores k + 1 positions in one wave.
+        k = key[1]
+        q = k + 1
+        flops = B * (q * fpt + attn_flops(cfg, q, W))
+        return float(flops), float(wb + B * (W * kvpt + q * kvpt))
+    if fam == "draft":
+        # (tag, k): the resident draft model's k proposal steps (the
+        # host n-gram drafter dispatches nothing and prices zero).
+        if draft_cfg is None:
+            return 0.0, 0.0
+        return cost_of_key(("decode", key[1]), draft_cfg,
+                           max_slots=max_slots,
+                           max_seq_len=min(max_seq_len,
+                                           draft_cfg.max_seq_len))
+    raise ValueError(f"unknown dispatch family {fam!r} (key {key!r})")
+
+
+# -- peaks ------------------------------------------------------------------
+
+
+def _cpu_microbench() -> Tuple[float, float]:
+    """One-shot achievable-peak calibration for platforms the table
+    does not know (CPU smoke runs): a small numpy matmul for FLOP/s
+    and an array copy for bytes/s, cached process-wide. Cold path only
+    — called from bind()/resolve_peaks, never under _book."""
+    global _MICROBENCH_PEAKS
+    if _MICROBENCH_PEAKS is not None:
+        return _MICROBENCH_PEAKS
+    try:
+        import time as _time
+
+        import numpy as np
+        n = 192
+        a = np.ones((n, n), np.float32)
+        b = np.ones((n, n), np.float32)
+        a @ b  # warm the BLAS path
+        t0 = _time.perf_counter()
+        reps = 8
+        for _ in range(reps):
+            a @ b
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        tflops = (2.0 * n ** 3 * reps) / dt / 1e12
+        src = np.ones((4 << 20,), np.uint8)
+        dst = np.empty_like(src)
+        np.copyto(dst, src)  # fault the pages
+        t0 = _time.perf_counter()
+        for _ in range(4):
+            np.copyto(dst, src)
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        gbs = (2.0 * src.nbytes * 4) / dt / 1e9
+        _MICROBENCH_PEAKS = (max(tflops, 1e-4), max(gbs, 1e-3))
+    except Exception:  # numpy absent/broken: fixed conservative floor
+        logger.debug("roof: peak microbench unavailable", exc_info=True)
+        _MICROBENCH_PEAKS = _FALLBACK_PEAKS
+    return _MICROBENCH_PEAKS
+
+
+def resolve_peaks(platform: str = "") -> Dict[str, Any]:
+    """{"tflops", "gbs", "source"} for a platform hint (the JAX
+    device_kind string). Resolution order: ROOF_PEAK_TFLOPS /
+    ROOF_PEAK_GBS env (each may override individually) > the builtin
+    table > the one-shot CPU microbench."""
+    plat = (platform or "").lower()
+    tflops = gbs = None
+    source = "table"
+    for frag, (tf, gb) in _PEAK_TABLE:
+        if frag in plat:
+            tflops, gbs = tf, gb
+            break
+    if tflops is None:
+        tflops, gbs = _cpu_microbench()
+        source = "microbench"
+    env_tf = os.environ.get("ROOF_PEAK_TFLOPS", "")
+    env_gb = os.environ.get("ROOF_PEAK_GBS", "")
+    if env_tf:
+        try:
+            tflops, source = float(env_tf), "env"
+        except ValueError:
+            logger.warning("ROOF_PEAK_TFLOPS=%r is not a float", env_tf)
+    if env_gb:
+        try:
+            gbs, source = float(env_gb), "env"
+        except ValueError:
+            logger.warning("ROOF_PEAK_GBS=%r is not a float", env_gb)
+    return {"tflops": float(tflops), "gbs": float(gbs), "source": source}
+
+
+def roofline_ms(flops: float, bytes_: float, peaks: Dict[str, Any]) -> float:
+    """Roofline time estimate: the binding resource's service time."""
+    return 1000.0 * max(flops / (peaks["tflops"] * 1e12),
+                        bytes_ / (peaks["gbs"] * 1e9))
+
+
+def predict(prompt_len: int, max_new: int, config, *,
+            max_slots: int = 1, max_seq_len: int = 0,
+            peaks: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
+    """Per-request cost surface: prefill `prompt_len` then `max_new`
+    decode steps at their true growing context, weight reads amortized
+    over `max_slots` concurrent rows (marginal cost at the serving
+    batch — the tier-routing signal). est_ms is the roofline service
+    time at `peaks` (resolved fresh when not supplied), and
+    1000 / est_ms its implied saturated req/s."""
+    prompt_len = max(int(prompt_len), 0)
+    max_new = max(int(max_new), 0)
+    b = max(int(max_slots), 1)
+    fpt = flops_per_token(config)
+    kvpt = kv_bytes_per_token(config)
+    wb = weight_bytes(config)
+    flops = prompt_len * fpt + causal_attn_flops(config, prompt_len)
+    # sum of contexts prompt_len+1 .. prompt_len+max_new
+    ctx_sum = max_new * prompt_len + max_new * (max_new + 1) // 2
+    flops += max_new * fpt + attn_flops(config, 1, 1) * ctx_sum
+    bytes_ = (prompt_len + max_new) * kvpt          # KV writes
+    bytes_ += ctx_sum * kvpt                        # decode KV reads
+    bytes_ += (1 + max_new) * wb / b                # amortized weights
+    if peaks is None:
+        peaks = resolve_peaks()
+    return {
+        "flops": float(flops),
+        "bytes": float(bytes_),
+        "est_ms": roofline_ms(float(flops), float(bytes_), peaks),
+    }
+
+
+# -- the ledger -------------------------------------------------------------
+
+
+class RoofLedger:
+    """MFU/MBU roofline + host/device step decomposition ledger.
+
+    Mutators run single-writer on the scheduler (or fetcher) thread
+    under ``_book``; snapshot() is lock-free and may see a torn window,
+    never a torn record (the sched-ledger contract)."""
+
+    def __init__(self):
+        self._cfg = None
+        self._draft_cfg = None
+        self._geom: Dict[str, int] = {
+            "max_slots": 1, "max_seq_len": 1, "kv_block": 0,
+            "ragged_chunk": 0,
+        }
+        self._platform = ""
+        self._peaks = resolve_peaks("")
+        # key -> [dispatches, flops, bytes, device_ms, predicted_ms]
+        self._variants: Dict[Key, List[float]] = {}
+        self._cost_cache: Dict[Key, Tuple[float, float]] = {}
+        self._predict_cache: Dict[Tuple[int, int], float] = {}
+        self._waves = 0
+        # Step decomposition accumulators (ms).
+        self._boundaries = 0
+        self._wall_ms = 0.0
+        self._host_pre_ms = 0.0
+        self._device_ms = 0.0
+        self._host_post_ms = 0.0
+        self._overlap_ms = 0.0
+        # Conservation audit state.
+        self._audit_checked = 0
+        self._audit_breaches = 0
+        self._last_breach: Optional[str] = None
+
+    # -- wiring (engine __init__, cold) --------------------------------------
+
+    def bind(self, cfg, *, max_slots: int, max_seq_len: int,
+             kv_block: int = 0, ragged_chunk: int = 0, draft_cfg=None,
+             platform: str = "") -> None:
+        """Capture the model config + engine geometry and resolve the
+        peak table once (the CPU microbench, when it fires, fires HERE
+        — engine init, never the hot path)."""
+        self._cfg = cfg
+        self._draft_cfg = draft_cfg
+        self._geom = {
+            "max_slots": int(max_slots),
+            "max_seq_len": int(max_seq_len),
+            "kv_block": int(kv_block),
+            "ragged_chunk": int(ragged_chunk),
+        }
+        self._platform = platform or ""
+        self._peaks = resolve_peaks(self._platform)
+        self._cost_cache.clear()
+        self._predict_cache.clear()
+
+    def _cost(self, key: Key) -> Tuple[float, float]:
+        got = self._cost_cache.get(key)
+        if got is None:
+            try:
+                got = cost_of_key(key, self._cfg, draft_cfg=self._draft_cfg,
+                                  **self._geom)
+            except (ValueError, TypeError, AttributeError):
+                # Unknown/foreign key shapes must never wedge the
+                # scheduler — price zero and let the lint lattice pass
+                # catch the real drift.
+                logger.debug("roof: unpriceable key %r", key, exc_info=True)
+                got = (0.0, 0.0)
+            self._cost_cache[key] = got
+        return got
+
+    # -- hot path (scheduler/fetcher thread, under _book) --------------------
+
+    def note_wave(self, keys: List[Key], device_ms: float) -> None:
+        """Join one boundary's dispatch keys with its measured device
+        time: the wave's device_ms splits across its keys weighted by
+        each key's roofline estimate (equal split when nothing prices),
+        so per-variant device time stays conserved across the wave."""
+        if not keys:
+            return
+        self._waves += 1
+        ests = []
+        for key in keys:
+            flops, bytes_ = self._cost(key)
+            ests.append(roofline_ms(flops, bytes_, self._peaks))
+        total_est = sum(ests)
+        for key, est in zip(keys, ests):
+            share = (device_ms * est / total_est if total_est > 0.0
+                     else device_ms / len(keys))
+            flops, bytes_ = self._cost(key)
+            row = self._variants.get(key)
+            if row is None and len(self._variants) >= _MAX_VARIANTS:
+                key = _OVERFLOW_KEY
+                row = self._variants.get(key)
+            if row is None:
+                row = [0, 0.0, 0.0, 0.0, 0.0]
+                self._variants[key] = row
+            row[0] += 1
+            row[1] += flops
+            row[2] += bytes_
+            row[3] += share
+            row[4] += est
+
+    def note_step(self, host_pre_ms: float, device_ms: float,
+                  host_post_ms: float, span_ms: float) -> None:
+        """One dispatched boundary's wall-time decomposition. The span
+        is measured independently (step start -> post-processing done);
+        overlap is the pipelined gap where THIS boundary sat in flight
+        while the scheduler ran other boundaries' host work."""
+        self._boundaries += 1
+        self._host_pre_ms += max(0.0, host_pre_ms)
+        self._device_ms += max(0.0, device_ms)
+        self._host_post_ms += max(0.0, host_post_ms)
+        self._overlap_ms += max(
+            0.0, span_ms - host_pre_ms - device_ms - host_post_ms
+        )
+        self._wall_ms += max(0.0, span_ms)
+
+    def audit(self) -> None:
+        """Conservation check, run under ``_book`` at every boundary
+        (the sched ledger's audit slot): the four components must
+        re-sum to the measured boundary wall within 1%."""
+        self._audit_checked += 1
+        parts = (self._host_pre_ms + self._device_ms + self._host_post_ms
+                 + self._overlap_ms)
+        if abs(parts - self._wall_ms) > max(1.0, 0.01 * self._wall_ms):
+            self._breach(
+                f"step components {parts:.3f} ms != boundary wall "
+                f"{self._wall_ms:.3f} ms (pre {self._host_pre_ms:.3f} + "
+                f"device {self._device_ms:.3f} + post "
+                f"{self._host_post_ms:.3f} + overlap "
+                f"{self._overlap_ms:.3f})"
+            )
+
+    def _breach(self, msg: str) -> None:
+        self._audit_breaches += 1
+        self._last_breach = msg
+        logger.warning("roof-ledger conservation breach: %s", msg)
+
+    # -- cost surface --------------------------------------------------------
+
+    def predict_request_ms(self, prompt_len: int, max_new: int) -> float:
+        """Memoized per-request roofline estimate at the bound geometry
+        — the predicted cost stamped into the sched ledger's wait
+        attribution and the pilot's signal snapshot."""
+        ck = (int(prompt_len), int(max_new))
+        got = self._predict_cache.get(ck)
+        if got is None:
+            if len(self._predict_cache) >= _MAX_PREDICT_CACHE:
+                self._predict_cache.clear()
+            got = predict(
+                prompt_len, max_new, self._cfg,
+                max_slots=self._geom["max_slots"],
+                max_seq_len=self._geom["max_seq_len"],
+                peaks=self._peaks,
+            )["est_ms"]
+            self._predict_cache[ck] = got
+        return got
+
+    # -- readers -------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        peaks = dict(self._peaks)
+        pf = peaks["tflops"] * 1e12
+        pb = peaks["gbs"] * 1e9
+        variants: List[Dict[str, Any]] = []
+        tot_d = 0
+        tot_f = tot_b = tot_ms = tot_pred = 0.0
+        for k, v in sorted(self._variants.items(),
+                           key=lambda kv: key_str(kv[0])):
+            disp, flops, bytes_, dms, pred = (
+                int(v[0]), v[1], v[2], v[3], v[4]
+            )
+            secs = dms / 1000.0
+            mfu = min(1.0, flops / (secs * pf)) if secs > 0.0 else 0.0
+            mbu = min(1.0, bytes_ / (secs * pb)) if secs > 0.0 else 0.0
+            if max(mfu, mbu) < HOST_BOUND_FRAC:
+                bound = "host"
+            elif mfu >= mbu:
+                bound = "compute"
+            else:
+                bound = "bandwidth"
+            variants.append({
+                "key": key_str(k),
+                "family": str(k[0]),
+                "dispatches": disp,
+                "flops": flops,
+                "bytes": bytes_,
+                "device_ms": round(dms, 3),
+                "predicted_ms": round(pred, 3),
+                "mfu": round(mfu, 6),
+                "mbu": round(mbu, 6),
+                "bound": bound,
+            })
+            tot_d += disp
+            tot_f += flops
+            tot_b += bytes_
+            tot_ms += dms
+            tot_pred += pred
+        secs = tot_ms / 1000.0
+        wall = self._wall_ms
+        return {
+            "enabled": True,
+            "platform": self._platform,
+            "peaks": peaks,
+            "boundaries": self._boundaries,
+            "waves": self._waves,
+            "step": {
+                "wall_ms": round(wall, 3),
+                "host_pre_ms": round(self._host_pre_ms, 3),
+                "device_ms": round(self._device_ms, 3),
+                "host_post_ms": round(self._host_post_ms, 3),
+                "overlap_ms": round(self._overlap_ms, 3),
+            },
+            "host_frac": (
+                round((self._host_pre_ms + self._host_post_ms) / wall, 6)
+                if wall > 0.0 else 0.0
+            ),
+            "device_frac": (
+                round(self._device_ms / wall, 6) if wall > 0.0 else 0.0
+            ),
+            "conservation": {
+                "checked": self._audit_checked,
+                "breaches": self._audit_breaches,
+                "last_breach": self._last_breach,
+            },
+            "variants": variants,
+            "totals": {
+                "dispatches": tot_d,
+                "flops": tot_f,
+                "bytes": tot_b,
+                "device_ms": round(tot_ms, 3),
+                "predicted_ms": round(tot_pred, 3),
+                "mfu": (round(min(1.0, tot_f / (secs * pf)), 6)
+                        if secs > 0.0 else 0.0),
+                "mbu": (round(min(1.0, tot_b / (secs * pb)), 6)
+                        if secs > 0.0 else 0.0),
+            },
+        }
+
+
+def from_env() -> Optional[RoofLedger]:
+    """Ledger iff ROOF_LEDGER=1; None otherwise — callers keep a None
+    attribute and the raw dispatch path (compile-ledger idiom). The
+    engine additionally forces DISPATCH_TIMING on when the roof is up:
+    the roofline is the timing join."""
+    if os.environ.get("ROOF_LEDGER", "0") not in ("1", "true", "True"):
+        return None
+    return RoofLedger()
+
+
+# Every family above must stay priced; a FAMILIES entry this module
+# does not handle raises in cost_of_key, and tests/test_cost_model.py
+# pins the covered set to FAMILIES exactly.
+assert set(FAMILIES) == {
+    "deactivate", "admit", "admit-prefix", "admit-paged", "chunk",
+    "seed-prefix", "cow", "decode", "ragged", "draft", "verify",
+}, "shape_lattice.FAMILIES drifted — update cost_of_key"

@@ -44,8 +44,9 @@ from seldon_tpu.models import ragged_attention, transformer
 from seldon_tpu.models import spec_decode as spec_model
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
-from seldon_tpu.servers import compile_ledger, controller, flight_recorder
-from seldon_tpu.servers import graftsan, hbm_ledger, sched_ledger, shape_lattice
+from seldon_tpu.servers import compile_ledger, controller, cost_model
+from seldon_tpu.servers import flight_recorder, graftsan, hbm_ledger
+from seldon_tpu.servers import sched_ledger, shape_lattice
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -1021,11 +1022,38 @@ class InferenceEngine:
         self._timing_on = os.environ.get(
             "DISPATCH_TIMING", "0"
         ) in ("1", "true", "True")
+        # graftroof (ROOF_LEDGER=1; None — and zero hot-path code —
+        # otherwise): analytical FLOPs/bytes pricing of every dispatch
+        # key joined with the measured wave timing into per-variant
+        # MFU/MBU, plus the host-pre/device/host-post boundary
+        # decomposition served at /debug/roof. The roofline IS the
+        # timing join, so ROOF_LEDGER implies DISPATCH_TIMING (the
+        # PILOT-implies-sched-ledger idiom).
+        self._roof = cost_model.from_env()
+        if self._roof is not None:
+            self._timing_on = True
+            dev = jax.devices()[0]
+            self._roof.bind(
+                self.cfg,
+                max_slots=self.ecfg.max_slots,
+                max_seq_len=self.ecfg.max_seq_len,
+                kv_block=self._kv_block if self._paged else 0,
+                ragged_chunk=self._ragged_chunk if self._ragged else 0,
+                draft_cfg=getattr(self, "_draft_cfg", None),
+                platform=(getattr(dev, "device_kind", "") or dev.platform),
+            )
         self._observe = self._cledger is not None or self._timing_on
         # Variant keys dispatched since the last boundary sync, paired
         # with the boundary wall time in _process_boundary. Written only
         # by the scheduler thread between dispatch and boundary.
         self._wave_keys: List[Tuple[Any, ...]] = []
+        # Roofline decomposition taps (all dead when _roof is None):
+        # dispatch-step entry stamp and the wave's accumulated jit
+        # enqueue seconds. Same single-writer contract as _wave_keys
+        # (scheduler thread between dispatch and boundary; warmup and
+        # the pre-thread start() reset run before the scheduler exists).
+        self._step_t0 = 0.0
+        self._wave_enq_s = 0.0
         self._hbm = hbm_ledger.from_env()
         if self._hbm is not None:
             self._hbm.set_static("weights", sum(
@@ -1803,6 +1831,25 @@ class InferenceEngine:
         with self._book:
             return self._pilot.snapshot()
 
+    def debug_roof(self) -> Optional[Dict[str, Any]]:
+        """Roofline snapshot (per-variant MFU/MBU against the platform
+        peaks, host-pre/device/host-post boundary decomposition,
+        conservation audit), or None when ROOF_LEDGER is off — the
+        /debug/roof payload. Lock-free like the sched ledger: the
+        window may tear, a record never does."""
+        if self._roof is None:
+            return None
+        return self._roof.snapshot()
+
+    def roof_predict_ms(self, prompt_len: int,
+                        max_new: int) -> Optional[float]:
+        """Cost-model roofline estimate for one request at this
+        engine's geometry (bench/tier-routing surface), or None when
+        ROOF_LEDGER is off."""
+        if self._roof is None:
+            return None
+        return self._roof.predict_request_ms(prompt_len, max_new)
+
     def _hbm_kv_reserved_bytes(self) -> int:
         """Static KV reservation: the full cache tree (dense slot slab
         or paged block pool). nbytes is shape metadata — no sync."""
@@ -1928,6 +1975,7 @@ class InferenceEngine:
             # Warmup dispatches never meet a boundary; drop their keys so
             # the first live wave's timing isn't charged to them.
             self._wave_keys = []
+            self._wave_enq_s = 0.0
             if self._async_fetch:
                 self._fetcher = threading.Thread(
                     target=self._fetch_loop, daemon=True
@@ -2286,6 +2334,10 @@ class InferenceEngine:
                     self._recorder.record("retrace", rid, witness)
         if self._timing_on:
             self._wave_keys.append(key)
+            if self._roof is not None:
+                # Enqueue seconds feed the roofline's device component;
+                # the host-pre residue is step span minus this.
+                self._wave_enq_s += seconds
 
     def _cow(self, src: int, dst: int, rid: int = -1) -> None:
         """Copy-on-write block copy through the one shared jit variant
@@ -2423,7 +2475,21 @@ class InferenceEngine:
             "free_slots": len(self._free),
             "spec_drafted": sled["spec"]["drafted_tokens"],
             "spec_accepted": sled["spec"]["accepted_tokens"],
+            "roof_backlog_ms": self._roof_backlog_ms(),
         }
+
+    def _roof_backlog_ms(self) -> float:  # graftlint: holds(_book)
+        """Predicted roofline cost (ms) of everything still queued —
+        the cost-model level the tier router consumes. 0.0 when the
+        roof ledger is down (the signal key stays schema-stable)."""
+        if self._roof is None:
+            return 0.0
+        total = 0.0
+        for req in self._waiting:
+            total += self._roof.predict_request_ms(
+                len(req.tokens), req.params.max_new_tokens
+            )
+        return round(total, 3)
 
     def _pilot_tick(self) -> None:  # graftlint: holds(_book)
         """One pilot boundary: advance the control loop and mirror any
@@ -2454,7 +2520,13 @@ class InferenceEngine:
                 n += 1
                 if self._sled is not None:
                     self._sled.note_first_dispatch(
-                        req.rid, req.submitted_at, now
+                        req.rid, req.submitted_at, now,
+                        predicted_ms=(
+                            self._roof.predict_request_ms(
+                                len(req.tokens),
+                                req.params.max_new_tokens,
+                            ) if self._roof is not None else 0.0
+                        ),
                     )
                 if self._recorder is not None:
                     self._recorder.record(
@@ -3532,11 +3604,7 @@ class InferenceEngine:
             if self._sled is not None:
                 detail["waste_frac"] = round(wf, 4)
             self._recorder.record("boundary", -1, detail)
-        if self._timing_on:
-            timing = (time.perf_counter(), self._wave_keys)
-            self._wave_keys = []
-        else:
-            timing = None
+        timing = self._make_timing() if self._timing_on else None
         self._dispatch_wreck = None
         return (admits, (toks_d, valid_d, active_d), roster, timing)
 
@@ -3646,11 +3714,7 @@ class InferenceEngine:
                 f.copy_to_host_async()
                 d.copy_to_host_async()
         if admits or chunk_handles is not None:
-            if self._timing_on:
-                timing = (time.perf_counter(), self._wave_keys)
-                self._wave_keys = []
-            else:
-                timing = None
+            timing = self._make_timing() if self._timing_on else None
             self._dispatch_wreck = None
             return (admits, chunk_handles, roster, timing)
         self._dispatch_wreck = None
@@ -4060,25 +4124,86 @@ class InferenceEngine:
                           timing=None) -> None:
         """Fetch one boundary's device results (one parallel transfer) and
         run host bookkeeping. `timing` is the wave's (dispatch t0,
-        variant keys) pair when DISPATCH_TIMING is on, None otherwise."""
+        variant keys, roof rider) triple when DISPATCH_TIMING is on,
+        None otherwise."""
         if self._chaos is not None:
             self._chaos.maybe_slow_boundary()  # graftlint: allow(lock-block) deliberate chaos fault: a slow boundary under _book is exactly the race window being tested
+        roofing = self._roof is not None and timing is not None
+        f0 = time.perf_counter() if roofing else 0.0
         admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync, lock-block) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
             (
                 [(f, d) for _, _, f, d in admits],
                 chunk_handles,
             )
         )
+        f1 = time.perf_counter() if roofing else 0.0
         self._process_admits(admits, admit_data)
         if chunk_data is not None:
             self._process_chunk(*chunk_data, roster)
         if self._spec:
             self._spec_post_process(chunk_data, roster)
         self._record_wave_timing(timing)
+        if roofing:
+            self._roof_note_boundary(timing, f0, f1)
         if self._san is not None:
             self._san.audit(self)
         if self._sled is not None:
             self._sled.audit()
+
+    def _make_timing(self):  # graftlint: holds(_book)
+        """Boundary timing token built at dispatch end: (stamp, wave
+        keys, roof rider). The rider — (host_pre_s, enqueue_s) relative
+        to the step-entry stamp — is the decomposition half the
+        roofline joins with the boundary-side stamps; it stays None
+        when the roof is down so the tuple costs nothing extra."""
+        now = time.perf_counter()
+        rider = None
+        if self._roof is not None:
+            enq = self._wave_enq_s
+            rider = (max(0.0, now - self._step_t0 - enq), enq)
+            self._wave_enq_s = 0.0
+        keys = self._wave_keys
+        self._wave_keys = []
+        return (now, keys, rider)
+
+    def _roof_note_boundary(self, timing, f0: float,
+                            f1: float) -> None:  # graftlint: holds(_book)
+        """Roofline boundary tap: close the step decomposition (host-
+        pre from the dispatch rider, device = jit enqueue + boundary
+        fetch, host-post = bookkeeping after the fetch, overlap = the
+        pipelined in-flight gap) against the independently measured
+        span, join the wave's keys with the device time, run the
+        conservation audit, and mirror one flight-recorder "roof"
+        record for the trace_view host/device lanes."""
+        t0, keys, rider = timing
+        if rider is None:
+            return
+        f2 = time.perf_counter()
+        host_pre_s, enq_s = rider
+        fetch_s = max(0.0, f1 - f0)
+        gap_s = max(0.0, f0 - t0)
+        post_s = max(0.0, f2 - f1)
+        device_s = enq_s + fetch_s
+        # Span re-derived from the same stamps the components use, so
+        # the audit's 1% tolerance is a real accumulation-drift check,
+        # not a tautology over one float.
+        span_s = host_pre_s + enq_s + max(0.0, f2 - t0)
+        self._roof.note_step(
+            1000.0 * host_pre_s, 1000.0 * device_s,
+            1000.0 * post_s, 1000.0 * span_s,
+        )
+        if keys:
+            self._roof.note_wave(keys, 1000.0 * device_s)
+        self._roof.audit()
+        if self._recorder is not None:
+            self._recorder.record(
+                "roof", -1,
+                {"pre_ms": round(1000.0 * host_pre_s, 3),
+                 "enq_ms": round(1000.0 * enq_s, 3),
+                 "gap_ms": round(1000.0 * gap_s, 3),
+                 "fetch_ms": round(1000.0 * fetch_s, 3),
+                 "post_ms": round(1000.0 * post_s, 3)},
+            )
 
     def _record_wave_timing(self, timing) -> None:  # graftlint: holds(_book)
         """Per-variant boundary timing: the wave's dispatch keys against
@@ -4088,7 +4213,7 @@ class InferenceEngine:
         the scheduler thread or the fetcher under _book)."""
         if timing is None:
             return
-        t0, keys = timing
+        t0, keys = timing[0], timing[1]
         if not keys:
             return
         ms = 1000.0 * (time.perf_counter() - t0)
@@ -4212,14 +4337,19 @@ class InferenceEngine:
                     self._san.perturb("boundary")
                 if self._chaos is not None:
                     self._chaos.maybe_slow_boundary()
+                roofing = self._roof is not None and timing is not None
+                f0 = time.perf_counter() if roofing else 0.0
                 admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
                     ([(f, d) for _, _, f, d in admits], chunk_handles)
                 )
+                f1 = time.perf_counter() if roofing else 0.0
                 with self._book:
                     self._process_admits(admits, admit_data)
                     if chunk_data is not None:
                         self._process_chunk(*chunk_data, roster)
                     self._record_wave_timing(timing)
+                    if roofing:
+                        self._roof_note_boundary(timing, f0, f1)
                     if self._san is not None:
                         self._san.audit(self)
                     if self._sled is not None:
@@ -4410,6 +4540,8 @@ class InferenceEngine:
         exception, self._dispatch_wreck holds the partial boundary so
         the error path can fail recycled-out-of-_slots requests."""
         self._dispatch_wreck = None
+        if self._roof is not None:
+            self._step_t0 = time.perf_counter()
         self._reap_lifecycle()
         if self._ragged:
             # graftragged: the whole step is ONE fused wave — no
@@ -4464,11 +4596,7 @@ class InferenceEngine:
                 if self._sled is not None:
                     detail["waste_frac"] = round(wf, 4)
                 self._recorder.record("boundary", -1, detail)
-            if self._timing_on:
-                timing = (time.perf_counter(), self._wave_keys)
-                self._wave_keys = []
-            else:
-                timing = None
+            timing = self._make_timing() if self._timing_on else None
             self._dispatch_wreck = None
             return (admits, (toks, valid, active_after), roster, timing)
         self._dispatch_wreck = None
@@ -4517,6 +4645,8 @@ class InferenceEngine:
             admits, roster = [], None  # visible to the except path
             try:
                 with self._book:
+                    if self._roof is not None:
+                        self._step_t0 = time.perf_counter()
                     self._reap_lifecycle()
                     admits = (
                         self._dispatch_prefill_chunks() if self._chunked
@@ -4561,13 +4691,12 @@ class InferenceEngine:
                             self._recorder.record("boundary", -1, detail)
                     else:
                         chunk_handles = None
-                    if self._timing_on and (
-                        admits or chunk_handles is not None
-                    ):
-                        timing = (time.perf_counter(), self._wave_keys)
-                        self._wave_keys = []
-                    else:
-                        timing = None
+                    timing = (
+                        self._make_timing()
+                        if self._timing_on
+                        and (admits or chunk_handles is not None)
+                        else None
+                    )
                     if pending is not None:
                         self._process_boundary(*pending)
                     pending = (

@@ -638,6 +638,13 @@ class JAXServer(SeldonComponent):
             return None
         return self.engine.debug_pilot()
 
+    def debug_roof(self) -> Optional[Dict]:
+        """Engine roofline snapshot for the /debug/roof endpoint
+        (None when ROOF_LEDGER is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_roof()
+
     def _observatory_metrics(self, s: Dict) -> List[Dict]:
         """Compile/HBM/sched-ledger and per-variant dispatch gauges.
         Empty when the observatory is off — the Prometheus surface only
@@ -740,6 +747,24 @@ class JAXServer(SeldonComponent):
                 {"type": "GAUGE", "key": "jaxserver_pilot_goodput_delta",
                  "value": float(
                      pilot["counterfactual"]["goodput_delta"])},
+            ])
+        roof = self.engine.debug_roof()
+        if roof is not None:
+            for v in roof["variants"]:
+                out.extend([
+                    {"type": "GAUGE", "key": "jaxserver_mfu",
+                     "value": float(v["mfu"]),
+                     "tags": {"variant": v["key"]}},
+                    {"type": "GAUGE", "key": "jaxserver_mbu",
+                     "value": float(v["mbu"]),
+                     "tags": {"variant": v["key"]}},
+                ])
+            out.extend([
+                {"type": "GAUGE", "key": "jaxserver_host_frac",
+                 "value": float(roof["host_frac"])},
+                {"type": "GAUGE",
+                 "key": "jaxserver_roof_conservation_breaches",
+                 "value": float(roof["conservation"]["breaches"])},
             ])
         return out
 

@@ -82,7 +82,10 @@ Conservation invariants (checked by ``audit()``; gated in CI by
       "preempted_tokens": int,      # prompt + generated work discarded
       "wait": {"requests": int, "total_ms": float, "pool_ms": float,
                "bucket_ms": float, "budget_ms": float,
-               "sched_ms": float},
+               "sched_ms": float,
+               "predicted_ms": float},  # graftroof cost stamp (not a
+                                        # wait component; excluded from
+                                        # the conservation re-sum)
       "conservation": {"checked": int, "breaches": int,
                        "last_breach": str | None},
       "by_shape": [                 # per-variant waste, compile-ledger
@@ -157,6 +160,7 @@ class SchedLedger:
         self._wait_bucket_ms = 0.0
         self._wait_budget_ms = 0.0
         self._wait_sched_ms = 0.0
+        self._wait_predicted_ms = 0.0  # graftroof cost stamp (off-path 0)
         # Current-wave delta marks for boundary_waste() (the recorder's
         # per-boundary waste_frac counter lane).
         self._wave_cells = 0
@@ -271,12 +275,17 @@ class SchedLedger:
         self._preempted_tokens += tokens
 
     def note_first_dispatch(self, rid: int, submitted_at: float,
-                            now: float) -> None:
+                            now: float, predicted_ms: float = 0.0) -> None:
         """Attribute one request's queue wait at its first dispatch.
         Components are claimed in priority order (pool stall, then
         bucket mismatch, then budget contention), each clamped to the
         wait still unclaimed, so they sum to the measured wait exactly;
-        the remainder is the inherent scheduler-boundary interval."""
+        the remainder is the inherent scheduler-boundary interval.
+        `predicted_ms` is the roofline cost model's service-time
+        estimate for the request (graftroof; 0.0 when that ledger is
+        off) — accumulated beside the wait so waits can be read against
+        the predicted work they bought, without entering the
+        conservation re-sum."""
         wait_ms = max(0.0, 1000.0 * (now - submitted_at))
         m = self._wait_marks.pop(rid, None) or {}
         pool_ms = bucket_ms = budget_ms = 0.0
@@ -297,6 +306,7 @@ class SchedLedger:
         self._wait_bucket_ms += bucket_ms
         self._wait_budget_ms += budget_ms
         self._wait_sched_ms += rem
+        self._wait_predicted_ms += max(0.0, predicted_ms)
 
     # -- conservation audit (under _book) ------------------------------------
 
@@ -419,6 +429,7 @@ class SchedLedger:
                 "bucket_ms": round(self._wait_bucket_ms, 3),
                 "budget_ms": round(self._wait_budget_ms, 3),
                 "sched_ms": round(self._wait_sched_ms, 3),
+                "predicted_ms": round(self._wait_predicted_ms, 3),
             },
             "conservation": {
                 "checked": self._audit_checked,

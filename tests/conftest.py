@@ -19,3 +19,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: dozens of engine tests compile the
+# SAME tiny-config kernel lattice from scratch (each bit-identical
+# on/off pair boots two engines). Keyed by HLO + compile options, so
+# hits return byte-identical executables — it changes wall time only.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("SELDON_TEST_JAX_CACHE",
+                                 "/tmp/seldon-jax-test-cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -97,6 +97,15 @@ def test_direction_heuristic():
     # Exact variant counts gate strictly: the static lattice is closed
     # form, so any growth is a real regression, not noise.
     assert d("detail.compile_variants") == "strict"
+    # graftroof: achieved utilization gates higher, scheduler-overhead
+    # share lower, and the model-side prediction stays informational.
+    assert d("detail.bench_1b.mfu") == "higher"
+    assert d("detail.bench_1b.mbu") == "higher"
+    assert d("detail.bench_1b.host_frac") == "lower"
+    assert d("detail.bench_1b.roof_predicted_req_s") == "info"
+    # predicted_vs_measured_req_s rides the req_s substring: a run that
+    # lands closer to its roofline prediction gates higher-is-better.
+    assert d("detail.predicted_vs_measured_req_s") == "higher"
 
 
 # ---------------------------------------------------------------------------

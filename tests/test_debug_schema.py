@@ -1,23 +1,26 @@
 """Frozen-schema golden tests for the debug observatory snapshots.
 
-``/debug/compile``, ``/debug/hbm``, ``/debug/sched`` and
-``/debug/pilot`` are consumed by parties that never import this repo's
-dataclasses: the loadtester's ledger polls, ``tools/compile_audit.py``
-/ ``tools/sched_audit.py`` / ``tools/pilot_audit.py``,
+``/debug/compile``, ``/debug/hbm``, ``/debug/sched``, ``/debug/pilot``
+and ``/debug/roof`` are consumed by parties that never import this
+repo's dataclasses: the loadtester's ledger polls,
+``tools/compile_audit.py`` / ``tools/sched_audit.py`` /
+``tools/pilot_audit.py`` / ``tools/roof_audit.py``,
 ``tools/probe_hbm``, and whatever dashboards operators curl together.
 Their schemas are frozen here as literal key sets.  If one of these
 tests fails, you changed the wire contract: update the module
 docstrings in ``seldon_tpu/servers/compile_ledger.py`` /
-``hbm_ledger.py`` / ``sched_ledger.py`` / ``controller.py``, the
-consumers above, AND these goldens in the same PR — never just the
-golden.
+``hbm_ledger.py`` / ``sched_ledger.py`` / ``controller.py`` /
+``cost_model.py``, the consumers above, AND these goldens in the same
+PR — never just the golden.
 """
 
 import json
 import time
 
+from seldon_tpu.models.config import get_config
 from seldon_tpu.servers.compile_ledger import CompileLedger
 from seldon_tpu.servers.controller import PilotController
+from seldon_tpu.servers.cost_model import RoofLedger
 from seldon_tpu.servers.hbm_ledger import HbmLedger
 from seldon_tpu.servers.sched_ledger import SchedLedger
 
@@ -78,7 +81,7 @@ SCHED_SPEC_KEYS = frozenset({
 })
 SCHED_WAIT_KEYS = frozenset({
     "requests", "total_ms", "pool_ms", "bucket_ms", "budget_ms",
-    "sched_ms",
+    "sched_ms", "predicted_ms",
 })
 SCHED_CONSERVATION_KEYS = frozenset({"checked", "breaches", "last_breach"})
 SCHED_SHAPE_KEYS = frozenset({
@@ -121,7 +124,36 @@ PILOT_SIGNAL_KEYS = frozenset({
     "budget_dispatches", "budget_starved_passes",
     "budget_offered_tokens", "budget_used_tokens", "pool_stall_events",
     "preemptions", "deadline_expired", "spec_drafted", "spec_accepted",
-    "goodput", "queue_depth", "free_slots",
+    "goodput", "queue_depth", "free_slots", "roof_backlog_ms",
+})
+
+# The documented /debug/roof schema, frozen (tools/roof_audit.py
+# carries the same top-level + variant goldens).
+ROOF_TOP_KEYS = frozenset({
+    "enabled",
+    "platform",
+    "peaks",
+    "boundaries",
+    "waves",
+    "step",
+    "host_frac",
+    "device_frac",
+    "conservation",
+    "variants",
+    "totals",
+})
+ROOF_PEAKS_KEYS = frozenset({"tflops", "gbs", "source"})
+ROOF_STEP_KEYS = frozenset({
+    "wall_ms", "host_pre_ms", "device_ms", "host_post_ms", "overlap_ms",
+})
+ROOF_CONSERVATION_KEYS = frozenset({"checked", "breaches", "last_breach"})
+ROOF_VARIANT_KEYS = frozenset({
+    "key", "family", "dispatches", "flops", "bytes", "device_ms",
+    "predicted_ms", "mfu", "mbu", "bound",
+})
+ROOF_TOTALS_KEYS = frozenset({
+    "dispatches", "flops", "bytes", "device_ms", "predicted_ms",
+    "mfu", "mbu",
 })
 
 
@@ -205,7 +237,7 @@ def _populated_pilot() -> PilotController:
         "budget_used_tokens": 0, "pool_stall_events": 0,
         "preemptions": 0, "deadline_expired": 0, "spec_drafted": 0,
         "spec_accepted": 0, "goodput": 1.0,
-        "queue_depth": 0, "free_slots": 4,
+        "queue_depth": 0, "free_slots": 4, "roof_backlog_ms": 0.0,
     }
     _windows(base)  # window 1 only baselines
     starved = dict(base, budget_dispatches=4, budget_starved_passes=4,
@@ -214,6 +246,22 @@ def _populated_pilot() -> PilotController:
     _windows(starved)  # window 2: budget raise decision
     _windows(dict(starved, goodput=0.75))  # window 3: effect measured
     return pilot
+
+
+def _populated_roof_ledger() -> RoofLedger:
+    """A ledger exercising every snapshot branch: bound geometry with
+    resolved peaks, priced waves across three families (one zero-flop
+    family so the host/bandwidth bound split is exercised), a decomposed
+    boundary, and a clean audit pass."""
+    led = RoofLedger()
+    led.bind(get_config("tiny"), max_slots=4, max_seq_len=64,
+             kv_block=16, platform="cpu-golden")
+    led.note_wave([("admit", 8, 2), ("cow",)], device_ms=5.0)
+    led.note_wave([("decode", 8)], device_ms=20.0)
+    led.note_step(host_pre_ms=1.0, device_ms=25.0, host_post_ms=2.0,
+                  span_ms=30.0)
+    led.audit()
+    return led
 
 
 def test_compile_snapshot_key_set_is_frozen():
@@ -402,6 +450,80 @@ def test_pilot_snapshot_empty_controller_same_keys():
     assert snap["ledger"] == []
 
 
+def test_roof_snapshot_key_set_is_frozen():
+    snap = _populated_roof_ledger().snapshot()
+    assert set(snap) == ROOF_TOP_KEYS
+    assert set(snap["peaks"]) == ROOF_PEAKS_KEYS
+    assert set(snap["step"]) == ROOF_STEP_KEYS
+    assert set(snap["conservation"]) == ROOF_CONSERVATION_KEYS
+    assert set(snap["totals"]) == ROOF_TOTALS_KEYS
+    assert snap["variants"], "fixture must produce variant entries"
+    for entry in snap["variants"]:
+        assert set(entry) == ROOF_VARIANT_KEYS
+
+
+def test_roof_snapshot_value_kinds():
+    snap = _populated_roof_ledger().snapshot()
+    assert snap["enabled"] is True
+    assert snap["platform"] == "cpu-golden"
+    assert snap["peaks"]["source"] in ("env", "table", "microbench")
+    assert isinstance(snap["peaks"]["tflops"], float)
+    assert snap["peaks"]["tflops"] > 0.0
+    assert isinstance(snap["boundaries"], int) and snap["boundaries"] == 1
+    assert isinstance(snap["waves"], int) and snap["waves"] == 2
+    for v in snap["step"].values():
+        assert isinstance(v, float) and v >= 0.0
+    # Decomposition restated from the snapshot itself: the components
+    # re-sum to the measured boundary wall (overlap absorbs the gap).
+    step = snap["step"]
+    parts = (step["host_pre_ms"] + step["device_ms"]
+             + step["host_post_ms"] + step["overlap_ms"])
+    assert abs(parts - step["wall_ms"]) <= max(1.0, 0.01 * step["wall_ms"])
+    assert 0.0 <= snap["host_frac"] <= 1.0
+    assert 0.0 <= snap["device_frac"] <= 1.0
+    # The fixture's audit() pass must have run clean.
+    assert snap["conservation"]["checked"] == 1
+    assert snap["conservation"]["breaches"] == 0
+    assert snap["conservation"]["last_breach"] is None
+    seen_bounds = set()
+    for entry in snap["variants"]:
+        # Keys render as the canonical slash-joined string, not tuples.
+        assert isinstance(entry["key"], str)
+        assert entry["family"] == entry["key"].split("/")[0]
+        assert 0.0 <= entry["mfu"] <= 1.0
+        assert 0.0 <= entry["mbu"] <= 1.0
+        assert entry["bound"] in ("compute", "bandwidth", "host")
+        seen_bounds.add(entry["bound"])
+        assert entry["dispatches"] >= 1
+        assert entry["device_ms"] >= 0.0
+    # The cow wave prices zero flops: it can never read compute-bound.
+    cow = [e for e in snap["variants"] if e["family"] == "cow"]
+    assert len(cow) == 1 and cow[0]["flops"] == 0.0
+    assert cow[0]["bound"] in ("bandwidth", "host")
+    tot = snap["totals"]
+    assert tot["dispatches"] == sum(
+        e["dispatches"] for e in snap["variants"])
+    # Wave device time is conserved across the per-variant split.
+    assert abs(tot["device_ms"] - sum(
+        e["device_ms"] for e in snap["variants"])) < 0.01
+    assert 0.0 <= tot["mfu"] <= 1.0
+    assert 0.0 <= tot["mbu"] <= 1.0
+
+
+def test_roof_snapshot_empty_ledger_same_keys():
+    # A never-touched ledger serves the SAME key set (consumers need no
+    # existence checks), just with empty/zero values.
+    snap = RoofLedger().snapshot()
+    assert set(snap) == ROOF_TOP_KEYS
+    assert set(snap["peaks"]) == ROOF_PEAKS_KEYS
+    assert set(snap["step"]) == ROOF_STEP_KEYS
+    assert set(snap["totals"]) == ROOF_TOTALS_KEYS
+    assert snap["variants"] == []
+    assert snap["boundaries"] == 0 and snap["waves"] == 0
+    assert snap["host_frac"] == 0.0 and snap["device_frac"] == 0.0
+    assert snap["totals"]["mfu"] == 0.0
+
+
 def test_snapshots_are_json_clean():
     # All snapshots must survive json.dumps untouched — they go over
     # the wire verbatim from the debug routes.
@@ -413,3 +535,5 @@ def test_snapshots_are_json_clean():
     assert set(sched) == SCHED_TOP_KEYS
     pilot = json.loads(json.dumps(_populated_pilot().snapshot()))
     assert set(pilot) == PILOT_TOP_KEYS
+    roof = json.loads(json.dumps(_populated_roof_ledger().snapshot()))
+    assert set(roof) == ROOF_TOP_KEYS

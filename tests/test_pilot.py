@@ -85,7 +85,8 @@ class _Signals:
 
     def __init__(self, **levels):
         self.cum = {k: 0 for k in controller._DELTA_KEYS}
-        self.levels = {"goodput": 1.0, "queue_depth": 0, "free_slots": 4}
+        self.levels = {"goodput": 1.0, "queue_depth": 0, "free_slots": 4,
+                       "roof_backlog_ms": 0.0}
         self.levels.update(levels)
 
     def advance(self, **vals):
@@ -294,7 +295,7 @@ def test_hold_mode_freezes_knobs():
     assert snap["windows"] == 4  # the ledger half still flies
     assert snap["decisions_total"] == 0
     assert snap["knobs"] == {"dispatch_token_budget": 8, "max_admit": 4,
-                             "chunk_bias": 0}
+                             "chunk_bias": 0, "spec_k": 0}
 
 
 # ---------------------------------------------------------------------------

@@ -15,13 +15,17 @@ and compared base -> candidate with a direction heuristic:
    ``frag``, ``dropped``, ``error``, plus the exact waste metrics
    ``padding_waste_frac`` / ``goodput_gap`` (the sched ledger's
    lost-capacity fractions — checked before the ``goodput`` substring
-   would claim them as higher-is-better);
+   would claim them as higher-is-better) and graftroof's ``host_frac``
+   (scheduler overhead share of the boundary wall);
  * higher-is-better: names containing ``req_per_s``, ``req_s``,
    ``tokens_per_s``, ``tok_s``, ``speedup``, ``hit_rate``, ``goodput``,
    ``coverage``, ``acceptance_rate`` (graftspec: a better drafter keeps
    more of every verify wave), plus the headline ``value`` /
-   ``vs_baseline``; the exact leaf ``dispatch_per_token`` gates
-   lower-is-better (verify waves compress the decode loop);
+   ``vs_baseline`` and graftroof's achieved ``mfu`` / ``mbu``; the
+   exact leaf ``dispatch_per_token`` gates lower-is-better (verify
+   waves compress the decode loop), and ``roof_predicted_req_s`` stays
+   informational (it moves when the COST MODEL changes, not when the
+   served binary regresses);
  * strict:           ``live_retraces`` and ``compile_variants`` — any
    increase over base fails regardless of tolerance (a retrace storm
    is a correctness-of-the-lattice bug, and the variant count is an
@@ -53,14 +57,21 @@ _LOWER = ("ms", "latency", "stall", "frag", "dropped", "error",
           "inversions")
 _HIGHER = ("req_per_s", "req_s", "tokens_per_s", "tok_s", "speedup",
            "hit_rate", "goodput", "coverage", "acceptance_rate")
-# Exact leaf-name matches for the headline numbers.
-_HIGHER_EXACT = ("value", "vs_baseline")
+# Exact leaf-name matches for the headline numbers. graftroof's
+# utilization gauges gate higher-is-better: a PR that drops achieved
+# MFU/MBU at the same throughput spent more hardware for the same work.
+_HIGHER_EXACT = ("value", "vs_baseline", "mfu", "mbu")
 # Exact lower-is-better leaves, checked BEFORE the substring tables:
 # "goodput_gap" would otherwise match the higher-is-better "goodput"
 # substring, and "padding_waste_frac" matches nothing ("frac" != "frag").
 # "dispatch_per_token" is graftspec's compression metric — verify waves
-# emitting more tokens per dispatch push it DOWN.
-_LOWER_EXACT = ("padding_waste_frac", "goodput_gap", "dispatch_per_token")
+# emitting more tokens per dispatch push it DOWN. "host_frac" is
+# graftroof's scheduler-overhead share of the boundary wall.
+_LOWER_EXACT = ("padding_waste_frac", "goodput_gap", "dispatch_per_token",
+                "host_frac")
+# Model-side constants, never gated: "roof_predicted_req_s" moves when
+# the COST MODEL changes, not when the served binary regresses.
+_INFO_EXACT = ("roof_predicted_req_s",)
 _STRICT = ("live_retraces", "compile_variants")
 
 
@@ -111,6 +122,8 @@ def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
 def direction(path: str) -> str:
     """'lower' | 'higher' | 'strict' | 'info' for a flattened path."""
     leaf = path.rsplit(".", 1)[-1]
+    if leaf in _INFO_EXACT:
+        return "info"
     if leaf in _STRICT:
         return "strict"
     if leaf in _LOWER_EXACT:

@@ -185,6 +185,28 @@ KNOBS = {
                           "(jaxserver_dispatch_ms_*), and the flight "
                           "recorder's dispatch records (per-variant "
                           "Perfetto lanes via tools/trace_view.py)."),
+    "ROOF_LEDGER": _k("runtime", "0",
+                      "Enable graftroof, the MFU/MBU roofline ledger: "
+                      "closed-form FLOPs + HBM-bytes pricing of every "
+                      "dispatch key joined with the measured wave timing "
+                      "(implies DISPATCH_TIMING) into per-variant "
+                      "compute/bandwidth/host-bound classification, plus "
+                      "the host-pre / device / host-post boundary "
+                      "decomposition with a 1% conservation audit. "
+                      "Served at /debug/roof, mirrored as jaxserver_mfu "
+                      "/ jaxserver_mbu / jaxserver_host_frac gauges and "
+                      "flight-recorder roof records (Perfetto host/"
+                      "device lanes); gated by `make roof-audit`."),
+    "ROOF_PEAK_TFLOPS": _k("runtime", "(unset)",
+                           "Operator override for the roofline's peak "
+                           "dense TFLOPS (the MFU denominator). Unset: "
+                           "the builtin per-platform table keyed on the "
+                           "JAX device_kind, falling back to a one-shot "
+                           "numpy microbench on unknown platforms."),
+    "ROOF_PEAK_GBS": _k("runtime", "(unset)",
+                        "Operator override for the roofline's peak HBM "
+                        "GB/s (the MBU denominator). Resolution order "
+                        "matches ROOF_PEAK_TFLOPS."),
     "TRACE_PROFILE_N": _k("runtime", "0",
                           "Capture a jax.profiler device trace over the "
                           "first N dispatched scheduler boundaries "

@@ -15,6 +15,12 @@ when mean acceptance clears it) and the full-acceptance speedup bound.
 ``MB_DRAFT=<preset>`` additionally times the resident draft model's
 proposal dispatch (models/spec_decode.draft_tokens); without it the
 n-gram drafter's host cost (~0) is assumed.
+
+``--roof`` adds graftroof's analytical prediction next to every
+measured number (servers/cost_model.cost_of_key at this bench's exact
+geometry, peaks resolved per platform env > table > microbench): the
+predicted ms per decode step / per verify wave and the measured-over-
+predicted ratio — the cost model's calibration check.
 """
 
 from __future__ import annotations
@@ -116,7 +122,29 @@ def bench(weights: str, kv: str, attn: str = "xla") -> float:
         f"{ms_per_step:7.3f} ms/step  {toks_per_s:9.0f} tok/s",
         flush=True,
     )
+    if ROOF:
+        pred = _roof_predict_ms(("decode", CHUNK), cfg) / CHUNK
+        print(
+            f"  roof: predicted {pred:7.3f} ms/step  "
+            f"measured/predicted {ms_per_step / pred:6.2f}x",
+            flush=True,
+        )
     return ms_per_step
+
+
+def _roof_predict_ms(key, cfg) -> float:
+    """Analytical roofline estimate of one dispatch of `key` at this
+    microbench's geometry, against the platform peaks."""
+    from seldon_tpu.servers import cost_model
+
+    dev = jax.devices()[0]
+    peaks = cost_model.resolve_peaks(
+        getattr(dev, "device_kind", "") or dev.platform
+    )
+    flops, bytes_ = cost_model.cost_of_key(
+        key, cfg, max_slots=SLOTS, max_seq_len=WINDOW, kv_block=64,
+    )
+    return cost_model.roofline_ms(flops, bytes_, peaks)
 
 
 def bench_spec(k: int, weights: str, kv: str, attn: str = "xla") -> None:
@@ -196,11 +224,26 @@ def bench_spec(k: int, weights: str, kv: str, attn: str = "xla") -> None:
         f"  full-accept speedup {speedup_full:.2f}x",
         flush=True,
     )
+    if ROOF:
+        pred_plain = _roof_predict_ms(("decode", 1), cfg)
+        pred_verify = _roof_predict_ms(("verify", k), cfg)
+        print(
+            f"  roof: predicted plain {pred_plain:7.3f} ms/step  "
+            f"verify {pred_verify:7.3f} ms/wave  "
+            f"measured/predicted {ms_plain / pred_plain:6.2f}x / "
+            f"{ms_verify / pred_verify:6.2f}x",
+            flush=True,
+        )
 
+
+ROOF = False
 
 if __name__ == "__main__":
     args = sys.argv[1:]
     spec_k = 0
+    if "--roof" in args:
+        args.remove("--roof")
+        ROOF = True
     if "--spec" in args:
         i = args.index("--spec")
         spec_k = int(args[i + 1])

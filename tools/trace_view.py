@@ -42,7 +42,14 @@ Rendering model:
    128->256" instants carrying the full rationale in args) plus "C"
    counter series for the live knob values (`pilot_budget`,
    `pilot_max_admit`, `pilot_chunk_bias`), so control actions line up
-   against the boundary/waste counters they reacted to.
+   against the boundary/waste counters they reacted to;
+ * "roof" records (ROOF_LEDGER=1) are graftroof's per-boundary step
+   decompositions — rendered on a fourth "roofline" process as a host
+   lane ("host-pre" / "host-post" slices) and a device lane ("enqueue"
+   / "fetch" slices), laid out backwards from the boundary-done stamp
+   with the pipelined in-flight gap left empty between them, so the
+   host-vs-device shape of every scheduler step reads directly off
+   the track (plus a `roof_host_ms` counter for the host share).
 
 Monotonic record timestamps convert to wall-clock microseconds via the
 snapshot's epoch pairing, so the device profile captured by
@@ -70,6 +77,9 @@ _VARIANT_PID = 2
 # Pilot decisions get their own process row: a decision lane + knob
 # counters, visually separate from both requests and variants.
 _PILOT_PID = 3
+# graftroof's host/device step decomposition: host lane (tid 0) +
+# device lane (tid 1) per boundary.
+_ROOF_PID = 4
 
 
 def _wall_us(snapshot: Dict[str, Any], ts: float) -> float:
@@ -107,6 +117,25 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                 "name": "thread_name", "args": {"name": "decisions"},
             })
         return 0
+
+    roof_named = False
+
+    def roof_tracks() -> None:
+        nonlocal roof_named
+        if not roof_named:
+            roof_named = True
+            events.append({
+                "ph": "M", "pid": _ROOF_PID, "name": "process_name",
+                "args": {"name": "seldon-tpu roofline"},
+            })
+            events.append({
+                "ph": "M", "pid": _ROOF_PID, "tid": 0,
+                "name": "thread_name", "args": {"name": "host"},
+            })
+            events.append({
+                "ph": "M", "pid": _ROOF_PID, "tid": 1,
+                "name": "thread_name", "args": {"name": "device"},
+            })
 
     def variant_track(key: str) -> int:
         tid = variant_tids.get(key)
@@ -219,6 +248,41 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                         "ph": "C", "pid": _PILOT_PID, "name": name,
                         "ts": ts, "args": {"value": detail[key]},
                     })
+        elif kind == "roof":
+            # Recorded when boundary processing finishes (ts = done
+            # stamp); the step's phases lay out backwards from there:
+            # pre, enqueue, [in-flight gap], fetch, post.
+            roof_tracks()
+            pre = max(float(detail.get("pre_ms", 0.0)), 0.0) * 1000.0
+            enq = max(float(detail.get("enq_ms", 0.0)), 0.0) * 1000.0
+            gap = max(float(detail.get("gap_ms", 0.0)), 0.0) * 1000.0
+            fetch = max(float(detail.get("fetch_ms", 0.0)), 0.0) * 1000.0
+            post = max(float(detail.get("post_ms", 0.0)), 0.0) * 1000.0
+            t_fetch = ts - post - fetch
+            t_enq = t_fetch - gap - enq
+            t_pre = t_enq - pre
+            events.append({
+                "ph": "X", "pid": _ROOF_PID, "tid": 0, "name": "host-pre",
+                "ts": t_pre, "dur": max(pre, 0.1), "args": detail,
+            })
+            events.append({
+                "ph": "X", "pid": _ROOF_PID, "tid": 1, "name": "enqueue",
+                "ts": t_enq, "dur": max(enq, 0.1), "args": detail,
+            })
+            events.append({
+                "ph": "X", "pid": _ROOF_PID, "tid": 1, "name": "fetch",
+                "ts": t_fetch, "dur": max(fetch, 0.1), "args": detail,
+            })
+            events.append({
+                "ph": "X", "pid": _ROOF_PID, "tid": 0, "name": "host-post",
+                "ts": ts - post, "dur": max(post, 0.1), "args": detail,
+            })
+            events.append({
+                "ph": "C", "pid": _ROOF_PID, "name": "roof_host_ms",
+                "ts": ts,
+                "args": {"host_ms": round(
+                    (pre + post) / 1000.0, 3)},
+            })
         else:
             events.append({
                 "ph": "i", "pid": 1, "tid": track(rid), "name": kind,
