@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit mesh-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -133,6 +133,20 @@ spec-audit:
 roof-audit:
 	env JAX_PLATFORMS=cpu python -m tools.roof_audit
 
+# Tensor-parallel serving gate (docs/operations.md "Serving on the
+# mesh"): the tiny ragged server booted twice on the fake 8-device CPU
+# mesh — pinned to an explicit single chip (tp=1), then as a TP=2
+# group via the env knob behind the real REST app — under a loadtester
+# window with GRAFTSAN + SCHED_LEDGER + COMPILE_LEDGER + HBM_LEDGER +
+# ROOF_LEDGER on. Asserts bit-exact greedy parity across a mixed-length
+# prompt matrix, one sealed lattice with zero live retraces for the
+# whole group, four-way sched + roofline conservation, zero sanitizer
+# violations, zero live KV bytes after the drain (leak-free), and the
+# per-device HBM invariants (weights = per-device x devices, KV
+# reservation halved per chip).
+mesh-audit:
+	env JAX_PLATFORMS=cpu python -m tools.mesh_audit
+
 bench:
 	python bench.py
 
@@ -144,7 +158,7 @@ bench-compare:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit
+ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit mesh-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

@@ -117,6 +117,21 @@ RAGGED_PHASE = os.environ.get("BENCH_RAGGED", "0") == "1"
 SPEC_PHASE = os.environ.get("BENCH_SPEC", "0") == "1"
 SPEC_K = int(os.environ.get("BENCH_SPEC_K", "4"))
 SPEC_DRAFT = os.environ.get("BENCH_SPEC_DRAFT", "self")
+# Mesh phase: the same greedy ragged closed wave run twice at EQUAL
+# engine config — an explicit single chip (tp=1) vs a BENCH_MESH_TP-way
+# graftmesh tensor-parallel group (servers/mesh_engine.py exact-TP
+# sharding) — so the bench line carries per-leg req/s and decode tok/s,
+# the bit-exact parity assert (exact-TP shards only output dims, so the
+# mesh leg must reproduce the single-chip stream token for token), and
+# the per-device HBM deltas the sharding bought (weights / KV bytes per
+# chip from the HBM ledger). On CPU smoke rigs run under
+# XLA_FLAGS=--xla_force_host_platform_device_count=8; tp speedup on
+# fake devices is NOT meaningful (one host executes all shards) — the
+# phase's CPU value is the parity + per-device-HBM record
+# (tools/bench_compare.py gates req/s no-regression and per-device KV
+# bytes lower-is-better on real meshes). Recorded in detail.mesh.
+MESH_PHASE = os.environ.get("BENCH_MESH", "0") == "1"
+MESH_TP = int(os.environ.get("BENCH_MESH_TP", "2"))
 PAGED_DENSE_SLOTS = int(os.environ.get("BENCH_PAGED_DENSE_SLOTS", "4"))
 PAGED_KV_BLOCK = int(os.environ.get("BENCH_PAGED_KV_BLOCK", "16"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
@@ -1342,6 +1357,121 @@ def _measure_spec(params, cfg) -> dict:
     }
 
 
+def _measure_mesh(params, cfg) -> dict:
+    """BENCH_MESH phase: the same greedy ragged closed wave run twice
+    at EQUAL engine config — an explicit single chip vs a MESH_TP-way
+    graftmesh tensor-parallel group on the same substrate, same pool,
+    same slots. Exact-TP shards only output dims (models/tp_sharding),
+    so the mesh leg must reproduce the single-chip stream bit for bit;
+    the phase asserts that, then prices what the mesh bought: per-leg
+    req/s and decode tok/s, and the per-device HBM deltas (weights /
+    KV bytes per chip) that are the actual reason to shard — a model
+    that doesn't fit one chip fits tp chips."""
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+    from seldon_tpu.servers.mesh_engine import MeshEngine, device_budget
+
+    tp = MESH_TP
+    budget = device_budget()
+    if budget < tp:
+        raise RuntimeError(
+            f"BENCH_MESH_TP={tp} but only {budget} devices visible "
+            "(on CPU rigs set XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8)")
+    # Per-device accounting is half the phase's point.
+    os.environ.setdefault("HBM_LEDGER", "1")
+
+    bs = 16          # KV block
+    new_toks = min(NEW_TOKENS, 16)
+    slots = 8
+    lengths = [24, 48, 96, 16]
+    smax = 128  # max prompt 96 + 16 new + slack, block-aligned
+    n_req = 3 * slots
+    pool_blocks = slots * (smax // bs) + 1  # full residency + trash
+    rng = np.random.default_rng(47)
+    prompts = [
+        rng.integers(3, cfg.vocab_size,
+                     size=(lengths[i % len(lengths)],)).tolist()
+        for i in range(n_req)
+    ]
+
+    def leg(leg_tp: int):
+        ecfg = EngineConfig(
+            max_slots=slots,
+            max_seq_len=smax,
+            prompt_buckets=(32, 128),
+            max_admit=4,
+            decode_chunk=4,
+            paged_kv=True, kv_block=bs, kv_pool_blocks=pool_blocks,
+            chunked_prefill=True, prefill_chunk=32, prefix_block=bs,
+            ragged=True,
+        )
+        if leg_tp > 1:
+            engine = MeshEngine(params, cfg, ecfg, tp=leg_tp)
+        else:
+            engine = InferenceEngine(params, cfg, ecfg)
+        engine.warmup()
+        engine.start()
+        t0 = time.perf_counter()
+        qs = [engine.submit(p, SamplingParams(
+                  temperature=0.0, top_k=0, top_p=1.0,
+                  max_new_tokens=new_toks, seed=i))
+              for i, p in enumerate(prompts)]
+        streams = []
+        for q in qs:
+            toks = []
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    break
+                if "error" in item:
+                    raise RuntimeError(item["error"])
+                toks.extend(item.get("tokens", []))
+            streams.append(toks)
+        dt = time.perf_counter() - t0
+        stats = engine.stats.snapshot()
+        out = {
+            "req_per_s": round(n_req / dt, 3),
+            "decode_tok_s": round(
+                stats["tokens_out"] / dt if dt else 0.0, 1),
+            "makespan_s": round(dt, 3),
+            **_compile_counts(engine),
+            **_sched_counts(engine),
+            **_roof_counts(engine),
+        }
+        hbm = engine.debug_hbm()
+        if hbm is not None:
+            cats = hbm["categories"]
+            out["hbm_devices"] = hbm["devices"]
+            out["weights_bytes_per_device"] = (
+                cats["weights"]["bytes_per_device"])
+            out["kv_bytes_per_device"] = (
+                cats["kv_cache"]["bytes_per_device"])
+            out["total_bytes_per_device"] = hbm["total_bytes_per_device"]
+        engine.stop()
+        return out, streams
+
+    single, want = leg(1)
+    mesh, got = leg(tp)
+    if got != want:  # the whole contract: sharding changes nothing
+        raise RuntimeError("mesh leg diverged from single-chip greedy "
+                           "stream")
+    return {
+        "tp": tp,
+        "single": single,
+        "mesh": mesh,
+        "bit_identical": True,
+        "speedup": (round(mesh["decode_tok_s"] / single["decode_tok_s"],
+                          3) if single["decode_tok_s"] else None),
+        "kv_per_device_frac": (
+            round(mesh["kv_bytes_per_device"]
+                  / single["kv_bytes_per_device"], 4)
+            if single.get("kv_bytes_per_device") else None),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1434,6 +1564,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"spec phase failed: {e!r}")
             detail["spec_error"] = str(e)
+
+    if MESH_PHASE:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["mesh"] = _measure_mesh(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"mesh phase failed: {e!r}")
+            detail["mesh_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

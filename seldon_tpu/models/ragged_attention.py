@@ -99,6 +99,7 @@ def ragged_prefill_phase(
     finals: jnp.ndarray,      # [B] bool — last chunk: sample + arm
     is_prefill: jnp.ndarray,  # [B] bool occupancy mask
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[State, jnp.ndarray, jnp.ndarray]:
     """The wave's prefill leg: run every occupied segment of the token
     buffer through prefill_with_prefix against the FULL block-table
@@ -117,7 +118,7 @@ def ragged_prefill_phase(
     toks = tokens.reshape(B, Sc)
     prefix_kv = transformer.paged_prefix_view(pool, table, nbs)
     logits, kv = transformer.prefill_with_prefix(
-        params, toks, plens, prefix_kv, starts, cfg
+        params, toks, plens, prefix_kv, starts, cfg, tp=tp
     )
     keys = jax.vmap(
         lambda s, p: jax.random.fold_in(jax.random.key(s), p)
@@ -162,6 +163,7 @@ def ragged_decode_phase(
     state: State,
     table: jnp.ndarray,
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[State, jnp.ndarray, jnp.ndarray]:
     """The wave's decode leg: ONE decode step over every slot, reading
     and writing KV through the block tables — ``_paged_chunk_impl``
@@ -177,7 +179,7 @@ def ragged_decode_phase(
         run = carry["active"]
         logits, pool = transformer.paged_decode_step(
             params, carry["last_tok"], carry["pos"], carry["cache"],
-            table, cfg,
+            table, cfg, tp=tp,
         )
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
@@ -226,6 +228,7 @@ def ragged_wave(
     finals: jnp.ndarray,
     is_prefill: jnp.ndarray,
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[State, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One full unified wave: prefill leg then decode leg in a single
     trace (ONE dispatch, ONE compiled variant). Returns
@@ -235,7 +238,8 @@ def ragged_wave(
     processing unchanged."""
     state, first, first_done = ragged_prefill_phase(
         params, state, table, tokens, plens, starts, seeds, temps,
-        top_ks, top_ps, max_news, finals, is_prefill, cfg,
+        top_ks, top_ps, max_news, finals, is_prefill, cfg, tp=tp,
     )
-    state, toks, valid = ragged_decode_phase(params, state, table, cfg)
+    state, toks, valid = ragged_decode_phase(params, state, table, cfg,
+                                             tp=tp)
     return state, first, first_done, toks, valid

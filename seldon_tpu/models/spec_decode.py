@@ -104,7 +104,7 @@ def gqa_attention_verify(
 
 
 def _run_blocks_verify(params, x, cfg, positions, inv_freq, mask_lt, pool,
-                       table):
+                       table, tp=None):
     """Layer scan for the VERIFY wave: per layer, gather the dense
     cache view through the block tables, scatter this wave's own
     suffix k/v into it in CACHE DTYPE (int8 round-trip — the very
@@ -119,7 +119,8 @@ def _run_blocks_verify(params, x, cfg, positions, inv_freq, mask_lt, pool,
     def body(carry, xs):
         bp, pl = xs
         h = transformer.rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = transformer._qkv(h, bp, cfg, positions, inv_freq)
+        q, k, v = transformer._qkv(h, bp, cfg, positions, inv_freq,
+                                   tp=tp)
         if quantized:
             kq, ksc = transformer._quantize_kv(k)  # [B,Sq,Hkv,(Dh)]
             vq, vsc = transformer._quantize_kv(v)
@@ -141,8 +142,10 @@ def _run_blocks_verify(params, x, cfg, positions, inv_freq, mask_lt, pool,
             q, cl["k"], cl["v"], k, v, mask_lt,
             k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
         )
+        if tp is not None:
+            attn = tp.gather(tp.flat(attn))
         x = carry + transformer._qdot(attn, bp, "wo", cfg)
-        x, aux = transformer._mlp_res(x, bp, cfg, None)
+        x, aux = transformer._mlp_res(x, bp, cfg, None, tp=tp)
         # ys in paged_scatter_tokens layout: [B, Hkv, Sq, (Dh)].
         fresh = {key: jnp.swapaxes(view[key], 1, 2) for key in view}
         return x, (fresh, aux)
@@ -158,6 +161,7 @@ def verify_wave(
     drafts: jnp.ndarray,  # [B, k] int32 proposed tokens
     wave: jnp.ndarray,  # [B] bool — row participates in this wave
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[State, jnp.ndarray, jnp.ndarray]:
     """One speculative verify wave over all B slots.
 
@@ -192,7 +196,7 @@ def verify_wave(
     x = transformer._embed_rows(params, inputs, transformer._dtype(cfg))
     inv_freq = transformer.rope_frequencies(cfg)
     x, fresh, _ = _run_blocks_verify(
-        params, x, cfg, positions, inv_freq, mask_lt, pool, table
+        params, x, cfg, positions, inv_freq, mask_lt, pool, table, tp=tp
     )
     # All Sq positions project to logits: Sq = k + 1 stays small, and
     # the acceptance chain below needs every row's candidate.

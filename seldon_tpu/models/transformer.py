@@ -36,7 +36,19 @@ def _w(container: Dict[str, Any], name: str, dtype) -> jnp.ndarray:
 
 def _quantize_act(x: jnp.ndarray):
     """Dynamic per-token symmetric int8 for W8A8 matmul inputs:
-    x [..., D] -> (int8 [..., D], f32 scale [..., 1])."""
+    x [..., D] -> (int8 [..., D], f32 scale [..., 1]).
+
+    The optimization_barrier pins the quantization input to the
+    MATERIALIZED activation: without it XLA may fuse this max into the
+    producer and reduce over unrounded f32 intermediates, making the
+    scale — and hence the int8 bits — a function of fusion choices.
+    Fusion differs between the single-chip and the SPMD-partitioned
+    (graftmesh tp>1) compilations of the same model, so an unpinned
+    scale breaks the engine's bit-exact-across-configs contract on
+    near-ties (observed: tp=2 vs tp=1 greedy divergence at the 128
+    bucket). The barrier costs one activation materialization the
+    int8 dot was about to force anyway."""
+    x = jax.lax.optimization_barrier(x)
     s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
     s = jnp.maximum(s, 1e-8)
     q = jnp.clip(
@@ -333,7 +345,13 @@ def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     Scales are stored bf16: their relative error (2^-8 ~ 0.4%) sits
     below the int8 quantization noise itself, and f32 scales measurably
     hurt — they double the scale read AND the full-array relayout copy
-    XLA inserts for the scale buffers each decode step."""
+    XLA inserts for the scale buffers each decode step.
+
+    The optimization_barrier pins the scale to the MATERIALIZED k/v
+    (same hazard as _quantize_act: a max fused into the rope/projection
+    producer reads unrounded f32 and its value drifts across the
+    single-chip vs SPMD-partitioned compilations of the same model)."""
+    x = jax.lax.optimization_barrier(x)
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
     scale = jnp.maximum(scale, 1e-8)
     q = jnp.clip(
@@ -414,18 +432,32 @@ def _run_blocks(params, x, cfg, positions, inv_freq, mask,
     return x, None, jnp.mean(aux)
 
 
-def _qkv(h, bp, cfg, positions, inv_freq):
+def _qkv(h, bp, cfg, positions, inv_freq, tp=None):
+    """`tp` (models/tp_sharding.TpHints, EngineConfig.tp > 1 only) pins
+    the projected heads sharded on 'tp': each device computes the FULL
+    d_model contraction for its own disjoint head slice, so per-element
+    reduction order — and hence the bits — match tp=1 exactly."""
     B, S, _ = h.shape
     Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
     hq = _quantize_act(h) if _w8a8_applies(bp, "wq", cfg) else None
     q = _qdot(h, bp, "wq", cfg, act_q=hq).reshape(B, S, cfg.n_heads, Dh)
     k = _qdot(h, bp, "wk", cfg, act_q=hq).reshape(B, S, Hkv, Dh)
     v = _qdot(h, bp, "wv", cfg, act_q=hq).reshape(B, S, Hkv, Dh)
-    return apply_rope(q, positions, inv_freq), apply_rope(k, positions, inv_freq), v
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    if tp is not None:
+        q, k, v = tp.heads(q), tp.heads(k), tp.heads(v)
+    return q, k, v
 
 
-def _mlp_res(x, bp, cfg, act_spec):
-    """Post-attention half of a block: residual + (SwiGLU | MoE)."""
+def _mlp_res(x, bp, cfg, act_spec, tp=None):
+    """Post-attention half of a block: residual + (SwiGLU | MoE).
+
+    Under `tp` the gate/up projections run output-sharded on d_ff and
+    the hidden is ALL-GATHERED (exact data movement) before the
+    REPLICATED w_down contraction — no partial-sum reduction ever forms,
+    keeping outputs bit-identical to tp=1 (tp_sharding module doc). MoE
+    weights replicate, so that branch needs no hints."""
     h = rms_norm(x, bp["mlp_norm"], cfg.rms_norm_eps)
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
@@ -435,6 +467,8 @@ def _mlp_res(x, bp, cfg, act_spec):
         hq = _quantize_act(h) if _w8a8_applies(bp, "w_gate", cfg) else None
         hidden = jax.nn.silu(_qdot(h, bp, "w_gate", cfg, act_q=hq)) \
             * _qdot(h, bp, "w_up", cfg, act_q=hq)
+        if tp is not None:
+            hidden = tp.gather(tp.flat(hidden))
         x = x + _qdot(hidden, bp, "w_down", cfg)
     if act_spec is not None:
         x = jax.lax.with_sharding_constraint(x, act_spec)
@@ -442,7 +476,7 @@ def _mlp_res(x, bp, cfg, act_spec):
 
 
 def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
-                        act_spec=None, ring_mesh=None):
+                        act_spec=None, ring_mesh=None, tp=None):
     """Layer scan for PREFILL: attention runs over the fresh k/v only
     (every serving prefill starts at position 0, so the fresh tokens ARE
     the whole visible window — the cache is never read) and each layer's
@@ -460,7 +494,7 @@ def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
 
     def body(carry, bp):
         h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq, tp=tp)
         B, S = q.shape[0], q.shape[1]
         if ring_mesh is not None and cfg.attn_impl == "ring" and S > 1:
             from seldon_tpu.parallel.ring_attention import ring_attention
@@ -483,10 +517,14 @@ def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
                     .transpose(0, 2, 1, 3).reshape(B, S, -1))
         else:
             attn = gqa_attention(q, k, v, mask)
+        if tp is not None:
+            # Exact all-gather of the head-sharded attention before the
+            # REPLICATED wo contraction (tp_sharding module doc).
+            attn = tp.gather(tp.flat(attn))
         x = carry + _qdot(attn, bp, "wo", cfg)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
-        x, aux = _mlp_res(x, bp, cfg, act_spec)
+        x, aux = _mlp_res(x, bp, cfg, act_spec, tp=tp)
         # ys in cache layout: [B, Hkv, S, Dh] per layer.
         return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), aux)
 
@@ -495,7 +533,7 @@ def _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
 
 
 def _run_blocks_prefill_prefix(params, x, cfg, positions, inv_freq, mask,
-                               prefix_kv):
+                               prefix_kv, tp=None):
     """Layer scan for SUFFIX prefill (prefix-cache admissions): attention
     runs over reused prefix KV plus the fresh suffix k/v. `prefix_kv` is
     {"k","v"[,"k_scale","v_scale"]} stacked [L, B, Hkv, Pb, (Dh)] in
@@ -509,7 +547,7 @@ def _run_blocks_prefill_prefix(params, x, cfg, positions, inv_freq, mask,
     def body(carry, xs):
         bp, pl = xs
         h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq, tp=tp)
         pk = pl["k"].astype(q.dtype)
         pv = pl["v"].astype(q.dtype)
         if quantized:
@@ -519,9 +557,13 @@ def _run_blocks_prefill_prefix(params, x, cfg, positions, inv_freq, mask,
         # token-major columns in front of the fresh suffix.
         k_all = jnp.concatenate([pk.transpose(0, 2, 1, 3), k], axis=1)
         v_all = jnp.concatenate([pv.transpose(0, 2, 1, 3), v], axis=1)
+        if tp is not None:
+            k_all, v_all = tp.heads(k_all), tp.heads(v_all)
         attn = gqa_attention(q, k_all, v_all, mask)
+        if tp is not None:
+            attn = tp.gather(tp.flat(attn))
         x = carry + _qdot(attn, bp, "wo", cfg)
-        x, aux = _mlp_res(x, bp, cfg, None)
+        x, aux = _mlp_res(x, bp, cfg, None, tp=tp)
         return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), aux)
 
     x, (ks, vs, aux) = jax.lax.scan(body, x, (params["blocks"], prefix_kv))
@@ -529,7 +571,7 @@ def _run_blocks_prefill_prefix(params, x, cfg, positions, inv_freq, mask,
 
 
 def _run_blocks_decode(params, x, cfg, positions, inv_freq, pos, cache,
-                       act_spec=None):
+                       act_spec=None, tp=None):
     """Layer scan for DECODE: the cache is read PRE-write (attention
     handles the current token via an exact fresh column) and all L
     layers' fresh k/v are written back AFTER the scan in one batched
@@ -555,12 +597,14 @@ def _run_blocks_decode(params, x, cfg, positions, inv_freq, pos, cache,
     def body(carry, xs):
         bp, cl = xs
         h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq, tp=tp)
         attn = attend(q, k, v, cl)
+        if tp is not None:
+            attn = tp.gather(tp.flat(attn))
         x = carry + _qdot(attn, bp, "wo", cfg)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
-        x, aux = _mlp_res(x, bp, cfg, act_spec)
+        x, aux = _mlp_res(x, bp, cfg, act_spec, tp=tp)
         if quantized:
             kq, ksc = _quantize_kv(k[:, 0])
             vq, vsc = _quantize_kv(v[:, 0])
@@ -761,7 +805,7 @@ def paged_scatter_tokens(
 
 
 def _run_blocks_decode_paged(params, x, cfg, positions, inv_freq, pos,
-                             pool, table):
+                             pool, table, tp=None):
     """Paged twin of _run_blocks_decode: per layer, K/V are GATHERED
     through the block table into the dense head-major view and fed to
     the SAME gqa_attention_decode — a pure relayout, so greedy decode is
@@ -777,14 +821,16 @@ def _run_blocks_decode_paged(params, x, cfg, positions, inv_freq, pos,
     def body(carry, xs):
         bp, pl = xs
         h = rms_norm(carry, bp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, bp, cfg, positions, inv_freq)
+        q, k, v = _qkv(h, bp, cfg, positions, inv_freq, tp=tp)
         cl = paged_gather_kv(pl, table)
         attn = gqa_attention_decode(
             q, cl["k"], cl["v"], k, v, mask_lt,
             k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
         )
+        if tp is not None:
+            attn = tp.gather(tp.flat(attn))
         x = carry + _qdot(attn, bp, "wo", cfg)
-        x, aux = _mlp_res(x, bp, cfg, None)
+        x, aux = _mlp_res(x, bp, cfg, None, tp=tp)
         if quantized:
             kq, ksc = _quantize_kv(k[:, 0])
             vq, vsc = _quantize_kv(v[:, 0])
@@ -827,15 +873,18 @@ def paged_decode_step(
     pool: Cache,  # [L, NB, Hkv, block, (Dh)] global block pool
     table: jnp.ndarray,  # [B, Smax // block] int32 block tables
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[jnp.ndarray, Cache]:
     """One autoregressive step over the paged pool. Returns
     (logits [B, V], updated pool) — the block-table twin of decode_step,
-    bit-identical for greedy outputs."""
+    bit-identical for greedy outputs. `tp` (tp_sharding.TpHints) runs
+    the step SPMD over the 'tp' mesh axis, still bit-identical."""
     x = _embed_rows(params, token, _dtype(cfg))[:, None, :]
     positions = pos[:, None]
     inv_freq = rope_frequencies(cfg)
     x, pool, _ = _run_blocks_decode_paged(params, x, cfg, positions,
-                                          inv_freq, pos, pool, table)
+                                          inv_freq, pos, pool, table,
+                                          tp=tp)
     return _logits(params, x, cfg)[:, 0], pool
 
 
@@ -846,6 +895,7 @@ def prefill(
     cache: Cache,
     cfg: ModelConfig,
     ring_mesh=None,
+    tp=None,
 ) -> Tuple[jnp.ndarray, Cache]:
     """Run prompts through the model, filling cache slots [0, S).
     Returns (next-token logits [B, V] taken at each row's last real token,
@@ -874,7 +924,8 @@ def prefill(
     # fresh tokens are the entire visible window (_run_blocks_prefill).
     # The stacked ys land in the cache in one update per array.
     x, kv, _ = _run_blocks_prefill(params, x, cfg, positions, inv_freq, mask,
-                                   ring_mesh=ring_mesh if use_ring else None)
+                                   ring_mesh=ring_mesh if use_ring else None,
+                                   tp=tp)
     if cfg.kv_cache_dtype == "int8":
         kq, ks = _quantize_kv(kv["k"])
         vq, vs = _quantize_kv(kv["v"])
@@ -905,6 +956,7 @@ def prefill_with_prefix(
     prefix_kv: Cache,  # [L, B, Hkv, Pb, (Dh)] reused prefix, cache dtype
     prefix_lens: jnp.ndarray,  # [B] true prefix lengths (<= Pb)
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[jnp.ndarray, Cache]:
     """Prefill that RESUMES at a position offset: runs only the uncached
     suffix of each prompt, attending to already-computed prefix KV
@@ -935,7 +987,7 @@ def prefill_with_prefix(
     )
     mask = jnp.concatenate([pmask, smask], axis=2)
     x, kv, _ = _run_blocks_prefill_prefix(
-        params, x, cfg, positions, inv_freq, mask, prefix_kv
+        params, x, cfg, positions, inv_freq, mask, prefix_kv, tp=tp
     )
     # Last real token of the SUFFIX (admissions cap the reused prefix at
     # prompt_len - 1, so there is always at least one suffix token).
@@ -950,11 +1002,12 @@ def decode_step(
     pos: jnp.ndarray,  # [B] int32 positions to write at
     cache: Cache,
     cfg: ModelConfig,
+    tp=None,
 ) -> Tuple[jnp.ndarray, Cache]:
     """One autoregressive step. Returns (logits [B, V], updated cache)."""
     x = _embed_rows(params, token, _dtype(cfg))[:, None, :]  # [B,1,D]
     positions = pos[:, None]
     inv_freq = rope_frequencies(cfg)
     x, cache, _ = _run_blocks_decode(params, x, cfg, positions, inv_freq,
-                                     pos, cache)
+                                     pos, cache, tp=tp)
     return _logits(params, x, cfg)[:, 0], cache

@@ -33,6 +33,8 @@ Design constraints (the flight-recorder discipline, applied again):
 
     {
       "warmup_complete": bool,
+      "tp": int,                    # TP group size (1 = single chip)
+      "mesh_devices": int,          # devices the sealed lattice serves
       "declared_variants": int,     # lattice size warmup declared
       "dispatched_variants": int,   # distinct keys seen at all
       "warmup_coverage": float,     # declared keys actually dispatched
@@ -82,8 +84,21 @@ class CompileLedger:
         self._compile_s_total = 0.0
         self._retraces: list = []
         self._retrace_count = 0
+        # graftmesh geometry: set once at engine init when the engine
+        # serves a TP group. SPMD partitioning happens inside each jit,
+        # so the lattice keys are tp-invariant; these fields let
+        # /debug/compile readers (and make mesh-audit) assert that ONE
+        # sealed lattice serves the whole group.
+        self._tp = 1
+        self._mesh_devices = 1
 
     # -- warmup-time ---------------------------------------------------------
+
+    def set_mesh(self, tp: int, devices: int) -> None:
+        """Record the TP group geometry this lattice serves (engine
+        init time, before any dispatch)."""
+        self._tp = int(tp)
+        self._mesh_devices = int(devices)
 
     def declare(self, key: Key) -> None:
         """Declare one expected lattice key without dispatching it."""
@@ -141,6 +156,8 @@ class CompileLedger:
         )
         return {
             "warmup_complete": self._warmup_complete,
+            "tp": self._tp,
+            "mesh_devices": self._mesh_devices,
             "declared_variants": len(declared),
             "dispatched_variants": len(counts),
             "warmup_coverage": (

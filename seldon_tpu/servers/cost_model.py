@@ -62,6 +62,9 @@ tests/test_debug_schema.py::ROOF_* goldens:
       "enabled": True,
       "platform": str,              # device_kind the peaks matched
       "peaks": {"tflops": float, "gbs": float, "source": str},
+      "tp": int,                    # TP group size costs divide over
+                                    #   (1 = single chip; peaks stay
+                                    #   per-chip either way — graftmesh)
       "boundaries": int,            # dispatched boundaries decomposed
       "waves": int,                 # note_wave joins (keys x timing)
       "step": {                     # cumulative decomposition, ms
@@ -152,64 +155,79 @@ def _kvbytes(cfg) -> int:
     return _DTYPE_BYTES.get(getattr(cfg, "kv_cache_dtype", "bf16"), 2)
 
 
-def matmul_params_per_layer(cfg) -> int:
-    """Matmul weights one token multiplies through per layer: fused qkv
-    + o projections and the SwiGLU triple (per-token active experts
-    under MoE — the router's d*E is noise and ignored)."""
+def matmul_params_per_layer(cfg, tp: int = 1) -> int:
+    """Matmul weights one token multiplies through PER CHIP per layer:
+    fused qkv + o projections and the SwiGLU triple (per-token active
+    experts under MoE — the router's d*E is noise and ignored).
+
+    graftmesh (tp > 1) prices the exact-TP split (models/tp_sharding):
+    qkv and gate/up shard their output dim over tp chips, while o and
+    down — whose contraction would need a psum — stay replicated and
+    run redundantly everywhere. MoE expert weights replicate entirely
+    (attention-only sharding), so only the qkv term divides."""
     hd = cfg.d_model // cfg.n_heads
     qkv = cfg.d_model * (cfg.n_heads * hd + 2 * cfg.n_kv_heads * hd)
     o = cfg.d_model * cfg.d_model
-    mlp = 3 * cfg.d_model * cfg.d_ff
     if getattr(cfg, "n_experts", 0):
-        mlp *= cfg.n_experts_per_token
-    return qkv + o + mlp
+        mlp = 3 * cfg.d_model * cfg.d_ff * cfg.n_experts_per_token
+    else:
+        mlp = (2 * cfg.d_model * cfg.d_ff) // tp + cfg.d_model * cfg.d_ff
+    return qkv // tp + o + mlp
 
 
-def flops_per_token(cfg) -> int:
-    """Dense forward FLOPs per token EXCLUDING attention-over-context
-    (that term depends on the key's window — see attn_flops): 2 flops
-    per matmul parameter, lm_head included."""
-    return 2 * (cfg.n_layers * matmul_params_per_layer(cfg)
+def flops_per_token(cfg, tp: int = 1) -> int:
+    """Dense forward FLOPs per token PER CHIP, EXCLUDING attention-over-
+    context (that term depends on the key's window — see attn_flops): 2
+    flops per resident matmul parameter, lm_head included (replicated —
+    every chip computes full logits, the exactness contract)."""
+    return 2 * (cfg.n_layers * matmul_params_per_layer(cfg, tp)
                 + cfg.d_model * cfg.vocab_size)
 
 
-def attn_flops(cfg, q_tokens: int, kv_len: int) -> int:
-    """Attention-over-context FLOPs: q_tokens query positions each
-    scoring + mixing kv_len cached positions across every layer — QK^T
-    and PV are 2 flops per (head, dim, position) each, and GQA shares
-    K/V without shrinking the query side: 4 * d_model * q * kv per
-    layer."""
-    return 4 * cfg.d_model * q_tokens * kv_len * cfg.n_layers
+def attn_flops(cfg, q_tokens: int, kv_len: int, tp: int = 1) -> int:
+    """Attention-over-context FLOPs PER CHIP: q_tokens query positions
+    each scoring + mixing kv_len cached positions across every layer —
+    QK^T and PV are 2 flops per (head, dim, position) each, and GQA
+    shares K/V without shrinking the query side: 4 * d_model * q * kv
+    per layer. Heads shard on 'tp', so per-chip attention divides."""
+    return 4 * cfg.d_model * q_tokens * kv_len * cfg.n_layers // tp
 
 
-def causal_attn_flops(cfg, s_tokens: int, prior: int = 0) -> int:
-    """Prefill attention: token i of a fresh s-token segment attends
-    prior + i + 1 positions — the arithmetic-series sum of attn_flops."""
+def causal_attn_flops(cfg, s_tokens: int, prior: int = 0,
+                      tp: int = 1) -> int:
+    """Prefill attention PER CHIP: token i of a fresh s-token segment
+    attends prior + i + 1 positions — the arithmetic-series sum of
+    attn_flops."""
     total_kv = s_tokens * prior + s_tokens * (s_tokens + 1) // 2
-    return 4 * cfg.d_model * total_kv * cfg.n_layers
+    return 4 * cfg.d_model * total_kv * cfg.n_layers // tp
 
 
-def weight_bytes(cfg) -> int:
-    """HBM bytes of one full weight read: matmul weights at the
-    serving weight dtype (ALL experts under MoE — a batched wave
+def weight_bytes(cfg, tp: int = 1) -> int:
+    """HBM bytes of one full weight read PER CHIP: matmul weights at
+    the serving weight dtype (ALL experts under MoE — a batched wave
     touches the lot), embeddings + lm_head at bf16 (they stay
-    unquantized, models/quantize.py)."""
-    mlp = 3 * cfg.d_model * cfg.d_ff
-    if getattr(cfg, "n_experts", 0):
-        mlp *= cfg.n_experts
+    unquantized, models/quantize.py). The exact-TP split shards only
+    qkv + gate/up; o / down / embeddings / lm_head are read whole on
+    every chip."""
     hd = cfg.d_model // cfg.n_heads
-    per_layer = (cfg.d_model * (cfg.n_heads * hd + 2 * cfg.n_kv_heads * hd)
-                 + cfg.d_model * cfg.d_model + mlp)
+    qkv = cfg.d_model * (cfg.n_heads * hd + 2 * cfg.n_kv_heads * hd)
+    o = cfg.d_model * cfg.d_model
+    if getattr(cfg, "n_experts", 0):
+        mlp = 3 * cfg.d_model * cfg.d_ff * cfg.n_experts
+    else:
+        mlp = (2 * cfg.d_model * cfg.d_ff) // tp + cfg.d_model * cfg.d_ff
+    per_layer = qkv // tp + o + mlp
     emb = cfg.vocab_size * cfg.d_model * 2          # bf16 embedding
     head = cfg.d_model * cfg.vocab_size * 2         # bf16 lm_head
     return cfg.n_layers * per_layer * _wbytes(cfg) + emb + head
 
 
-def kv_bytes_per_token(cfg) -> int:
-    """KV-cache bytes one token position occupies across every layer:
-    K + V at the kv dtype, GQA heads only."""
+def kv_bytes_per_token(cfg, tp: int = 1) -> int:
+    """KV-cache bytes one token position occupies across every layer
+    PER CHIP: K + V at the kv dtype, GQA heads only — the cache shards
+    exactly on its head axis, so tp divides cleanly."""
     hd = cfg.d_model // cfg.n_heads
-    return 2 * cfg.n_layers * cfg.n_kv_heads * hd * _kvbytes(cfg)
+    return 2 * cfg.n_layers * cfg.n_kv_heads * hd * _kvbytes(cfg) // tp
 
 
 # -- per-key closed forms ---------------------------------------------------
@@ -217,9 +235,11 @@ def kv_bytes_per_token(cfg) -> int:
 
 def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
                 kv_block: int = 0, ragged_chunk: int = 0,
-                draft_cfg=None) -> Tuple[float, float]:
-    """(flops, hbm_bytes) for ONE dispatch of a lattice key. Covers
-    every family in shape_lattice.FAMILIES (pinned by
+                draft_cfg=None, tp: int = 1) -> Tuple[float, float]:
+    """(flops, hbm_bytes) for ONE dispatch of a lattice key, PER CHIP
+    under tp > 1 (graftmesh: the helpers above shard exactly — per-chip
+    flops against the per-chip peak is the honest MFU). Covers every
+    family in shape_lattice.FAMILIES (pinned by
     tests/test_cost_model.py); raises ValueError on an unknown tag so
     a new dispatch family cannot silently price as zero.
 
@@ -229,9 +249,10 @@ def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
     engine actually dispatches, not the request's live length."""
     fam = key[0]
     B, W = max_slots, max_seq_len
-    fpt = flops_per_token(cfg)
-    kvpt = kv_bytes_per_token(cfg)
-    wb = weight_bytes(cfg)
+    tp = max(1, int(tp))
+    fpt = flops_per_token(cfg, tp)
+    kvpt = kv_bytes_per_token(cfg, tp)
+    wb = weight_bytes(cfg, tp)
     if fam == "deactivate":
         # One masked write over the per-slot scalars — no matmuls.
         return 0.0, float(B * 64)
@@ -244,30 +265,30 @@ def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
     if fam == "admit":
         # (tag, Sb, G): G rows prefill Sb tokens, causal attention.
         sb, g = key[1], key[2]
-        flops = g * (sb * fpt + causal_attn_flops(cfg, sb))
+        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, tp=tp))
         return float(flops), float(wb + g * sb * kvpt)
     if fam == "admit-prefix":
         # (tag, Pb, Sb, G): suffix Sb computed over a Pb-token prefix
         # already resident in the cache.
         pb, sb, g = key[1], key[2], key[3]
-        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, prior=pb))
+        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, prior=pb, tp=tp))
         return float(flops), float(wb + g * (pb + sb) * kvpt)
     if fam == "admit-paged":
         # (tag, Sb, G, W): paged admission, prefix width W resident.
         sb, g, pw = key[1], key[2], key[3]
-        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, prior=pw))
+        flops = g * (sb * fpt + causal_attn_flops(cfg, sb, prior=pw, tp=tp))
         return float(flops), float(wb + g * (pw + sb) * kvpt)
     if fam == "chunk":
         # (tag, Sc, G, W): G rows advance Sc prefill tokens against a
         # W-token resident view.
         sc, g, rw = key[1], key[2], key[3]
-        flops = g * (sc * fpt + causal_attn_flops(cfg, sc, prior=rw))
+        flops = g * (sc * fpt + causal_attn_flops(cfg, sc, prior=rw, tp=tp))
         return float(flops), float(wb + g * (rw + sc) * kvpt)
     if fam == "decode":
         # (tag, n): n sequential steps over every slot; every step
         # re-reads the weights and the full cache window.
         n = key[1]
-        flops = n * B * (fpt + attn_flops(cfg, 1, W) // 1)
+        flops = n * B * (fpt + attn_flops(cfg, 1, W, tp=tp) // 1)
         bytes_ = n * (wb + B * W * kvpt + B * kvpt)
         return float(flops), float(bytes_)
     if fam == "ragged":
@@ -275,17 +296,19 @@ def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
         # max_slots * C — low packing reads as low MFU by design.
         c = key[1] or ragged_chunk
         t = B * c
-        flops = t * fpt + attn_flops(cfg, t, W)
+        flops = t * fpt + attn_flops(cfg, t, W, tp=tp)
         return float(flops), float(wb + B * W * kvpt + t * kvpt)
     if fam == "verify":
         # (tag, k): every armed row scores k + 1 positions in one wave.
         k = key[1]
         q = k + 1
-        flops = B * (q * fpt + attn_flops(cfg, q, W))
+        flops = B * (q * fpt + attn_flops(cfg, q, W, tp=tp))
         return float(flops), float(wb + B * (W * kvpt + q * kvpt))
     if fam == "draft":
         # (tag, k): the resident draft model's k proposal steps (the
         # host n-gram drafter dispatches nothing and prices zero).
+        # The draft replicates across the TP group (tp_sharding shards
+        # the target only), so its per-chip cost is the full tp=1 cost.
         if draft_cfg is None:
             return 0.0, 0.0
         return cost_of_key(("decode", key[1]), draft_cfg,
@@ -373,23 +396,27 @@ def roofline_ms(flops: float, bytes_: float, peaks: Dict[str, Any]) -> float:
 
 def predict(prompt_len: int, max_new: int, config, *,
             max_slots: int = 1, max_seq_len: int = 0,
-            peaks: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
+            peaks: Optional[Dict[str, Any]] = None,
+            tp: int = 1) -> Dict[str, float]:
     """Per-request cost surface: prefill `prompt_len` then `max_new`
     decode steps at their true growing context, weight reads amortized
     over `max_slots` concurrent rows (marginal cost at the serving
     batch — the tier-routing signal). est_ms is the roofline service
     time at `peaks` (resolved fresh when not supplied), and
-    1000 / est_ms its implied saturated req/s."""
+    1000 / est_ms its implied saturated req/s. Under tp > 1 the cost
+    is per chip against the (per-chip) peaks — wall time on the mesh,
+    since every chip runs the same wave."""
     prompt_len = max(int(prompt_len), 0)
     max_new = max(int(max_new), 0)
     b = max(int(max_slots), 1)
-    fpt = flops_per_token(config)
-    kvpt = kv_bytes_per_token(config)
-    wb = weight_bytes(config)
-    flops = prompt_len * fpt + causal_attn_flops(config, prompt_len)
+    tp = max(1, int(tp))
+    fpt = flops_per_token(config, tp)
+    kvpt = kv_bytes_per_token(config, tp)
+    wb = weight_bytes(config, tp)
+    flops = prompt_len * fpt + causal_attn_flops(config, prompt_len, tp=tp)
     # sum of contexts prompt_len+1 .. prompt_len+max_new
     ctx_sum = max_new * prompt_len + max_new * (max_new + 1) // 2
-    flops += max_new * fpt + attn_flops(config, 1, 1) * ctx_sum
+    flops += max_new * fpt + attn_flops(config, 1, 1, tp=tp) * ctx_sum
     bytes_ = (prompt_len + max_new) * kvpt          # KV writes
     bytes_ += ctx_sum * kvpt                        # decode KV reads
     bytes_ += (1 + max_new) * wb / b                # amortized weights
@@ -417,7 +444,7 @@ class RoofLedger:
         self._draft_cfg = None
         self._geom: Dict[str, int] = {
             "max_slots": 1, "max_seq_len": 1, "kv_block": 0,
-            "ragged_chunk": 0,
+            "ragged_chunk": 0, "tp": 1,
         }
         self._platform = ""
         self._peaks = resolve_peaks("")
@@ -442,10 +469,12 @@ class RoofLedger:
 
     def bind(self, cfg, *, max_slots: int, max_seq_len: int,
              kv_block: int = 0, ragged_chunk: int = 0, draft_cfg=None,
-             platform: str = "") -> None:
+             platform: str = "", tp: int = 1) -> None:
         """Capture the model config + engine geometry and resolve the
         peak table once (the CPU microbench, when it fires, fires HERE
-        — engine init, never the hot path)."""
+        — engine init, never the hot path). `tp` is the TP group size
+        the engine shards over: costs become per-chip while the peaks
+        stay per-chip, so MFU/MBU read honestly on the mesh."""
         self._cfg = cfg
         self._draft_cfg = draft_cfg
         self._geom = {
@@ -453,6 +482,7 @@ class RoofLedger:
             "max_seq_len": int(max_seq_len),
             "kv_block": int(kv_block),
             "ragged_chunk": int(ragged_chunk),
+            "tp": max(1, int(tp)),
         }
         self._platform = platform or ""
         self._peaks = resolve_peaks(self._platform)
@@ -558,6 +588,7 @@ class RoofLedger:
                 max_slots=self._geom["max_slots"],
                 max_seq_len=self._geom["max_seq_len"],
                 peaks=self._peaks,
+                tp=self._geom["tp"],
             )["est_ms"]
             self._predict_cache[ck] = got
         return got
@@ -608,6 +639,7 @@ class RoofLedger:
             "enabled": True,
             "platform": self._platform,
             "peaks": peaks,
+            "tp": self._geom["tp"],
             "boundaries": self._boundaries,
             "waves": self._waves,
             "step": {

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from seldon_tpu.core import tracing
-from seldon_tpu.models import ragged_attention, transformer
+from seldon_tpu.models import ragged_attention, tp_sharding, transformer
 from seldon_tpu.models import spec_decode as spec_model
 from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
@@ -166,6 +166,20 @@ class EngineConfig:
     spec_decode: bool = False
     spec_k: int = 4  # max drafted tokens/wave; rungs are pow2 1..spec_k
     spec_draft: str = ""  # draft model preset; "" -> n-gram drafter
+    # graftmesh (opt-in): exact tensor parallelism over the mesh's 'tp'
+    # axis (models/tp_sharding.py). tp > 1 shards the qkv / gate / up
+    # projections and the KV cache's head axis across tp devices and
+    # runs every dispatch family SPMD, with greedy output bit-identical
+    # to tp=1 (output-dim-only sharding — no contraction is ever
+    # partitioned, so per-element reduction order matches a single
+    # chip). Requires a mesh whose 'tp' axis is exactly this size
+    # (servers/mesh_engine.build_tp_mesh) and tp | n_kv_heads,
+    # tp | n_heads, tp | d_ff. tp=1 (default) keeps every code path
+    # byte-identical to the pre-mesh engine — deliberately a CONFIG
+    # axis, not a global, so per-tier TP groups (Nitsum) can coexist
+    # in one process later. flash/ring attention kernels are not
+    # tp-threaded; engine __init__ rejects the combination.
+    tp: int = 1
     # Request-lifecycle hardening (defaults keep the dispatch path
     # byte-identical): TTL applied to requests that set no
     # SamplingParams.deadline_ms of their own, a bound on the admission
@@ -295,6 +309,10 @@ class EngineConfig:
                     f"verify variants compile one rung per pow2 k, and "
                     f"the pilot walks that ladder"
                 )
+        if self.tp < 1:
+            raise ValueError(
+                f"tp ({self.tp}) must be >= 1 (1 = no tensor parallelism)"
+            )
         if self.default_deadline_ms < 0:
             raise ValueError(
                 f"default_deadline_ms ({self.default_deadline_ms}) must be "
@@ -658,6 +676,25 @@ class InferenceEngine:
         self.ecfg = engine_cfg or EngineConfig()
         self.params = params
         self.mesh = mesh
+        # graftmesh: exact tensor parallelism (EngineConfig.tp > 1;
+        # models/tp_sharding.py). The gate is the CONFIG field, never
+        # the mesh shape — multi-process slice serving already passes a
+        # Megatron-sharded mesh here with the default config and must
+        # stay byte-identical. With tp > 1 the weights commit onto the
+        # mesh under the exact-TP table and self._tp threads sharding
+        # constraints through every jitted impl below; tp=1 leaves
+        # self._tp None and every partial without the kwarg.
+        self._tp = None
+        if self.ecfg.tp > 1:
+            tp_sharding.validate(self.cfg, self.ecfg.tp)
+            if self.cfg.attn_impl in ("flash", "ring"):
+                raise ValueError(
+                    f"tp={self.ecfg.tp} is not supported with "
+                    f"attn_impl={self.cfg.attn_impl!r} — only the gqa "
+                    f"attention family is tp-threaded"
+                )
+            self._tp = tp_sharding.hints(mesh, self.ecfg.tp)
+            self.params = tp_sharding.shard_params(mesh, self.cfg, params)
         B = self.ecfg.max_slots
 
         # Prompt buckets clamped to the cache window (empty -> whole window).
@@ -747,9 +784,13 @@ class InferenceEngine:
             and self.cfg.attn_impl == "ring"
             and dict(mesh.shape).get("sp", 1) > 1
         ) else None
+        # Conditional tp kwarg: tp=1 partials carry no extra binding at
+        # all, so their jit signatures — and traces — are byte-identical
+        # to a build without graftmesh.
+        tpkw = {"tp": self._tp} if self._tp is not None else {}
         self._jit_admit = jax.jit(
             functools.partial(self._admit_impl, cfg=self.cfg, mesh=mesh,
-                              ring_mesh=self._ring_mesh),
+                              ring_mesh=self._ring_mesh, **tpkw),
             donate_argnums=(1,),
         )
         # Prefix KV cache (opt-in, single-process only — the trie is
@@ -791,13 +832,14 @@ class InferenceEngine:
                 self._jit_admit_sub = jax.jit(
                     functools.partial(
                         self._admit_impl, cfg=self.cfg, mesh=mesh,
-                        ring_mesh=self._ring_mesh, return_sub=True,
+                        ring_mesh=self._ring_mesh, return_sub=True, **tpkw,
                     ),
                     donate_argnums=(1,),
                 )
                 self._jit_admit_prefix = jax.jit(
                     functools.partial(
                         self._admit_prefix_impl, cfg=self.cfg, mesh=mesh,
+                        **tpkw,
                     ),
                     donate_argnums=(1,),
                 )
@@ -821,7 +863,7 @@ class InferenceEngine:
                 self._jit_admit_chunk_paged = jax.jit(
                     functools.partial(
                         self._paged_admit_chunk_impl, cfg=self.cfg,
-                        mesh=mesh,
+                        mesh=mesh, **tpkw,
                     ),
                     static_argnames=("prefix_width",),
                     donate_argnums=(1,),
@@ -830,7 +872,7 @@ class InferenceEngine:
                 self._jit_admit_chunk = jax.jit(
                     functools.partial(
                         self._admit_chunk_impl, cfg=self.cfg, mesh=mesh,
-                        return_sub=self._prefix is not None,
+                        return_sub=self._prefix is not None, **tpkw,
                     ),
                     static_argnames=("prefix_width",),
                     donate_argnums=(1,),
@@ -851,6 +893,7 @@ class InferenceEngine:
             self._jit_admit_paged = jax.jit(
                 functools.partial(
                     self._paged_admit_impl, cfg=self.cfg, mesh=mesh,
+                    **tpkw,
                 ),
                 static_argnames=("prefix_width",),
                 donate_argnums=(1,),
@@ -877,6 +920,7 @@ class InferenceEngine:
                     cfg=self.cfg,
                     n_steps=n,
                     mesh=mesh,
+                    **tpkw,
                 ),
                 donate_argnums=(1,),
             )
@@ -890,6 +934,7 @@ class InferenceEngine:
                         cfg=self.cfg,
                         n_steps=n,
                         mesh=mesh,
+                        **tpkw,
                     ),
                     donate_argnums=(1,),
                 )
@@ -920,7 +965,7 @@ class InferenceEngine:
             )
             self._jit_ragged = jax.jit(
                 functools.partial(
-                    self._ragged_impl, cfg=self.cfg, mesh=mesh,
+                    self._ragged_impl, cfg=self.cfg, mesh=mesh, **tpkw,
                 ),
                 donate_argnums=(1,),
             )
@@ -958,7 +1003,7 @@ class InferenceEngine:
             self._spec_k_live = self._spec_rungs[-1]  # graftlint: guarded-by(_book)
             self._jit_verify = jax.jit(
                 functools.partial(
-                    self._verify_impl, cfg=self.cfg, mesh=mesh,
+                    self._verify_impl, cfg=self.cfg, mesh=mesh, **tpkw,
                 ),
                 donate_argnums=(1,),
             )
@@ -1019,6 +1064,14 @@ class InferenceEngine:
         # un-timed jit call on the off path — same zero-overhead-off
         # contract as the recorder above.
         self._cledger = compile_ledger.from_env()
+        if self._cledger is not None and self._tp is not None:
+            # One lattice serves the whole TP group: SPMD partitioning
+            # happens inside each jit, so variant keys — and the sealed
+            # lattice — are identical to tp=1. The snapshot carries the
+            # group geometry so /debug/compile readers can tell an
+            # 8-way mesh seal from a single-chip one.
+            self._cledger.set_mesh(self.ecfg.tp,
+                                   int(self._tp.mesh.devices.size))
         self._timing_on = os.environ.get(
             "DISPATCH_TIMING", "0"
         ) in ("1", "true", "True")
@@ -1041,6 +1094,7 @@ class InferenceEngine:
                 ragged_chunk=self._ragged_chunk if self._ragged else 0,
                 draft_cfg=getattr(self, "_draft_cfg", None),
                 platform=(getattr(dev, "device_kind", "") or dev.platform),
+                tp=self.ecfg.tp if self._tp is not None else 1,
             )
         self._observe = self._cledger is not None or self._timing_on
         # Variant keys dispatched since the last boundary sync, paired
@@ -1056,13 +1110,37 @@ class InferenceEngine:
         self._wave_enq_s = 0.0
         self._hbm = hbm_ledger.from_env()
         if self._hbm is not None:
-            self._hbm.set_static("weights", sum(
-                int(x.nbytes)
-                for x in jax.tree_util.tree_leaves(params)
-            ))
-            self._hbm.gauge("kv_cache", self._hbm_kv_reserved_bytes)
-            self._hbm.gauge("kv_live", self._hbm_kv_live_bytes)
-            self._hbm.gauge("prefix_cache", self._hbm_prefix_bytes)
+            if self._tp is None:
+                self._hbm.set_static("weights", sum(
+                    int(x.nbytes)
+                    for x in jax.tree_util.tree_leaves(params)
+                ))
+                self._hbm.gauge("kv_cache", self._hbm_kv_reserved_bytes)
+                self._hbm.gauge("kv_live", self._hbm_kv_live_bytes)
+                self._hbm.gauge("prefix_cache", self._hbm_prefix_bytes)
+            else:
+                # Per-device accounting on the mesh: weights are priced
+                # from each leaf's committed shard shape (replicated
+                # leaves cost a full copy per device, sharded leaves
+                # their slice — the exact-TP split); the mesh-total is
+                # devices x per-device resident bytes, so the ledger's
+                # conservation total == sum(categories) keeps holding
+                # per device AND mesh-wide. KV shards exactly on the
+                # head axis, so per-device = logical // tp.
+                tpn = self.ecfg.tp
+                self._hbm.set_devices(tpn)
+                per_dev = self._hbm_weights_device_bytes()
+                self._hbm.set_static("weights", per_dev * tpn,
+                                     per_device=per_dev)
+                self._hbm.gauge(
+                    "kv_cache", self._hbm_kv_reserved_bytes,
+                    per_device_fn=lambda:
+                        self._hbm_kv_reserved_bytes() // tpn)
+                self._hbm.gauge(
+                    "kv_live", self._hbm_kv_live_bytes,
+                    per_device_fn=lambda:
+                        self._hbm_kv_live_bytes() // tpn)
+                self._hbm.gauge("prefix_cache", self._hbm_prefix_bytes)
         # Scheduler waste observatory (SCHED_LEDGER=1; None — and zero
         # hot-path code — otherwise): per-boundary goodput attribution,
         # queue-wait decomposition, and the conservation audit that
@@ -1101,7 +1179,7 @@ class InferenceEngine:
             )
         else:
             cache = transformer.init_cache(self.cfg, B, Smax)
-        return {
+        state = {
             "cache": cache,
             "last_tok": jnp.zeros((B,), jnp.int32),
             "pos": jnp.zeros((B,), jnp.int32),
@@ -1112,6 +1190,13 @@ class InferenceEngine:
             "seeds": jnp.zeros((B,), jnp.uint32),
             "remaining": jnp.zeros((B,), jnp.int32),
         }
+        if self._tp is not None:
+            # Commit the state onto the mesh (KV heads on 'tp', per-slot
+            # scalars replicated) so the FIRST dispatch already sees the
+            # shardings every impl's constrain_state pins — one stable
+            # jit cache key from wave zero.
+            state = tp_sharding.shard_state(self._tp.mesh, state)
+        return state
 
     # --- jitted kernels -----------------------------------------------------
 
@@ -1134,7 +1219,7 @@ class InferenceEngine:
     def _admit_impl(
         params, state, toks, plens, seeds, temps, top_ks, top_ps,
         max_news, slots, *, cfg, mesh=None, ring_mesh=None,
-        return_sub=False,
+        return_sub=False, tp=None,
     ):
         """Fused admission: prefill [G, Sb], scatter into cache slots, sample
         first tokens, arm slot state. One dispatch, no host sync.
@@ -1151,7 +1236,7 @@ class InferenceEngine:
             if Sb % sp != 0:  # static per-bucket decision
                 ring_mesh = None
         logits, sub = transformer.prefill(params, toks, plens, sub, cfg,
-                                          ring_mesh=ring_mesh)
+                                          ring_mesh=ring_mesh, tp=tp)
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p)
         )(seeds, plens)
@@ -1185,6 +1270,8 @@ class InferenceEngine:
             "seeds": state["seeds"].at[slots].set(seeds),
             "remaining": state["remaining"].at[slots].set(max_news - 1),
         }
+        if tp is not None:
+            new_state = tp.constrain_state(new_state)
         first, first_done = InferenceEngine._replicate(
             mesh, first, first_done
         )
@@ -1198,7 +1285,7 @@ class InferenceEngine:
     @staticmethod
     def _admit_prefix_impl(
         params, state, toks, plens, prefix_lens, prefix_kv, seeds, temps,
-        top_ks, top_ps, max_news, slots, *, cfg, mesh=None,
+        top_ks, top_ps, max_news, slots, *, cfg, mesh=None, tp=None,
     ):
         """Fused WARM admission: suffix-only prefill attending to reused
         prefix KV, prefix + suffix scattered into the slot cache, first
@@ -1215,7 +1302,7 @@ class InferenceEngine:
         garbage before it is rewritten)."""
         G, Sq = toks.shape
         logits, kv = transformer.prefill_with_prefix(
-            params, toks, plens, prefix_kv, prefix_lens, cfg
+            params, toks, plens, prefix_kv, prefix_lens, cfg, tp=tp
         )
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p)
@@ -1262,6 +1349,8 @@ class InferenceEngine:
             "seeds": state["seeds"].at[slots].set(seeds),
             "remaining": state["remaining"].at[slots].set(max_news - 1),
         }
+        if tp is not None:
+            new_state = tp.constrain_state(new_state)
         first, first_done = InferenceEngine._replicate(
             mesh, first, first_done
         )
@@ -1271,7 +1360,7 @@ class InferenceEngine:
     def _admit_chunk_impl(
         params, state, toks, plens, starts, seeds, temps, top_ks, top_ps,
         max_news, slots, finals, *, prefix_width, cfg, mesh=None,
-        return_sub=False,
+        return_sub=False, tp=None,
     ):
         """Fused prefill CHUNK: run `toks` [G, Sc] (tokens
         [start, start+Sc) of each prompt) through prefill_with_prefix
@@ -1298,7 +1387,7 @@ class InferenceEngine:
             key: cache[key][:, slots, :, :prefix_width] for key in cache
         }
         logits, kv = transformer.prefill_with_prefix(
-            params, toks, plens, prefix_kv, starts, cfg
+            params, toks, plens, prefix_kv, starts, cfg, tp=tp
         )
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p)
@@ -1339,6 +1428,8 @@ class InferenceEngine:
             "seeds": state["seeds"].at[slots].set(seeds),
             "remaining": state["remaining"].at[slots].set(max_news - 1),
         }
+        if tp is not None:
+            new_state = tp.constrain_state(new_state)
         first, first_done = InferenceEngine._replicate(
             mesh, first, first_done
         )
@@ -1363,7 +1454,7 @@ class InferenceEngine:
         return {**state, "cache": new_cache}
 
     @staticmethod
-    def _chunk_impl(params, state, *, cfg, n_steps, mesh=None):
+    def _chunk_impl(params, state, *, cfg, n_steps, mesh=None, tp=None):
         """`n_steps` decode iterations over every slot in one lax.scan.
         Per-row termination (EOS / length budget / cache window) is
         value-level: finished rows stop advancing and emit invalid tokens
@@ -1373,7 +1464,8 @@ class InferenceEngine:
         def step(carry, _):
             run = carry["active"]
             logits, cache = transformer.decode_step(
-                params, carry["last_tok"], carry["pos"], carry["cache"], cfg,
+                params, carry["last_tok"], carry["pos"], carry["cache"],
+                cfg, tp=tp,
             )
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
@@ -1406,6 +1498,8 @@ class InferenceEngine:
             return new_carry, (tok, run)
 
         state, (toks, valid) = jax.lax.scan(step, state, None, length=n_steps)
+        if tp is not None:
+            state = tp.constrain_state(state)
         toks, valid, active = InferenceEngine._replicate(
             mesh, toks, valid, state["active"]
         )
@@ -1417,6 +1511,7 @@ class InferenceEngine:
     def _paged_admit_impl(
         params, state, table, toks, plens, prefix_lens, seeds, temps,
         top_ks, top_ps, max_news, slots, *, prefix_width, cfg, mesh=None,
+        tp=None,
     ):
         """Paged fused admission — ONE kernel covers cold and warm.
 
@@ -1440,7 +1535,7 @@ class InferenceEngine:
                 pool, table, prefix_width // block
             )
             logits, kv = transformer.prefill_with_prefix(
-                params, toks, plens, prefix_kv, prefix_lens, cfg
+                params, toks, plens, prefix_kv, prefix_lens, cfg, tp=tp
             )
             if cfg.kv_cache_dtype == "int8":
                 kq, ks = transformer._quantize_kv(kv["k"])
@@ -1453,7 +1548,7 @@ class InferenceEngine:
         else:
             sub = transformer.init_cache(cfg, G, Sb)
             logits, writes = transformer.prefill(params, toks, plens, sub,
-                                                 cfg)
+                                                 cfg, tp=tp)
             spos = jnp.broadcast_to(jnp.arange(Sb)[None, :], (G, Sb))
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p)
@@ -1477,6 +1572,8 @@ class InferenceEngine:
             "seeds": state["seeds"].at[slots].set(seeds),
             "remaining": state["remaining"].at[slots].set(max_news - 1),
         }
+        if tp is not None:
+            new_state = tp.constrain_state(new_state)
         first, first_done = InferenceEngine._replicate(
             mesh, first, first_done
         )
@@ -1486,6 +1583,7 @@ class InferenceEngine:
     def _paged_admit_chunk_impl(
         params, state, table, toks, plens, starts, seeds, temps, top_ks,
         top_ps, max_news, slots, finals, *, prefix_width, cfg, mesh=None,
+        tp=None,
     ):
         """Paged twin of _admit_chunk_impl: the resident KV of chunks
         0..k-1 (and any zero-copy warm prefix) is a block-table GATHER of
@@ -1503,7 +1601,7 @@ class InferenceEngine:
             pool, table, prefix_width // block
         )
         logits, kv = transformer.prefill_with_prefix(
-            params, toks, plens, prefix_kv, starts, cfg
+            params, toks, plens, prefix_kv, starts, cfg, tp=tp
         )
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.key(s), p)
@@ -1536,13 +1634,16 @@ class InferenceEngine:
             "seeds": state["seeds"].at[slots].set(seeds),
             "remaining": state["remaining"].at[slots].set(max_news - 1),
         }
+        if tp is not None:
+            new_state = tp.constrain_state(new_state)
         first, first_done = InferenceEngine._replicate(
             mesh, first, first_done
         )
         return new_state, first, first_done
 
     @staticmethod
-    def _paged_chunk_impl(params, state, table, *, cfg, n_steps, mesh=None):
+    def _paged_chunk_impl(params, state, table, *, cfg, n_steps, mesh=None,
+                          tp=None):
         """Paged twin of _chunk_impl: `n_steps` decode iterations reading
         K/V through the block tables (transformer.paged_decode_step).
         Per-row termination, sampling keys and masking are identical, so
@@ -1557,7 +1658,7 @@ class InferenceEngine:
             run = carry["active"]
             logits, pool = transformer.paged_decode_step(
                 params, carry["last_tok"], carry["pos"], carry["cache"],
-                table, cfg,
+                table, cfg, tp=tp,
             )
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1)
@@ -1589,6 +1690,8 @@ class InferenceEngine:
 
         state, (toks, valid) = jax.lax.scan(step, state, None,
                                             length=n_steps)
+        if tp is not None:
+            state = tp.constrain_state(state)
         toks, valid, active = InferenceEngine._replicate(
             mesh, toks, valid, state["active"]
         )
@@ -1626,6 +1729,7 @@ class InferenceEngine:
     def _ragged_impl(
         params, state, table, tokens, plens, starts, seeds, temps,
         top_ks, top_ps, max_news, finals, is_prefill, *, cfg, mesh=None,
+        tp=None,
     ):
         """graftragged: the ONE unified wave — every slot's prefill
         segment of the flat token buffer plus one decode step for every
@@ -1638,8 +1742,10 @@ class InferenceEngine:
         bit-identical to the bucketed engine (tests/test_ragged.py)."""
         state, first, first_done, toks, valid = ragged_attention.ragged_wave(
             params, state, table, tokens, plens, starts, seeds, temps,
-            top_ks, top_ps, max_news, finals, is_prefill, cfg,
+            top_ks, top_ps, max_news, finals, is_prefill, cfg, tp=tp,
         )
+        if tp is not None:
+            state = tp.constrain_state(state)
         first, first_done, toks, valid, active = InferenceEngine._replicate(
             mesh, first, first_done, toks, valid, state["active"]
         )
@@ -1647,7 +1753,7 @@ class InferenceEngine:
 
     @staticmethod
     def _verify_impl(params, state, table, drafts, wave, *, cfg,
-                     mesh=None):
+                     mesh=None, tp=None):
         """graftspec: ONE wide verify dispatch replacing up to k + 1
         sequential decode steps (models/spec_decode.verify_wave). The
         k rung is carried by the drafts width — one compile per rung,
@@ -1655,8 +1761,10 @@ class InferenceEngine:
         exact contract (toks/valid are [k+1, B] True-prefix columns),
         so _process_chunk consumes a wave unchanged."""
         state, toks, valid = spec_model.verify_wave(
-            params, state, table, drafts, wave, cfg
+            params, state, table, drafts, wave, cfg, tp=tp
         )
+        if tp is not None:
+            state = tp.constrain_state(state)
         toks, valid, active = InferenceEngine._replicate(
             mesh, toks, valid, state["active"]
         )
@@ -1849,6 +1957,20 @@ class InferenceEngine:
         if self._roof is None:
             return None
         return self._roof.predict_request_ms(prompt_len, max_new)
+
+    def _hbm_weights_device_bytes(self) -> int:
+        """Per-device resident weight bytes under the committed
+        shardings: each leaf costs its shard shape (full shape when
+        replicated — the exact-TP scheme keeps wo / w_down / embeddings
+        whole on every chip). Shape metadata only — no sync."""
+        total = 0
+        for x in jax.tree_util.tree_leaves(self.params):
+            shp = x.shape
+            sh = getattr(x, "sharding", None)
+            if sh is not None:
+                shp = sh.shard_shape(x.shape)
+            total += int(np.prod(shp, dtype=np.int64)) * x.dtype.itemsize
+        return total
 
     def _hbm_kv_reserved_bytes(self) -> int:
         """Static KV reservation: the full cache tree (dense slot slab

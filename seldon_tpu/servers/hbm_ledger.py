@@ -26,15 +26,27 @@ snapshot — never a device sync.  Rules of the house:
 
     {
       "categories": {
-        name: {"bytes": int, "high_bytes": int, "static": bool}
+        name: {"bytes": int, "bytes_per_device": int,
+               "high_bytes": int, "static": bool}
       },
-      "total_bytes": int,        # sum of current bytes
+      "devices": int,                  # mesh devices accounted (1 = chip)
+      "total_bytes": int,              # sum of current bytes (mesh-wide)
+      "total_bytes_per_device": int,   # sum of per-device bytes
       "total_high_bytes": int,   # sum of per-category high-watermarks
     }
 
 Expected category names: "weights", "kv_cache" (static reservation),
 "kv_live" (bytes holding active request state), "prefix_cache",
 "workspace".
+
+graftmesh (tp > 1) grows per-device accounting, not a new schema mode:
+``set_devices`` records the mesh size, ``set_static``/``gauge`` take an
+optional per-device figure (weights: the committed shard bytes; KV:
+logical // tp — the head axis shards exactly), and categories without
+one report their full bytes per device (replicated / conservative —
+workspace and host-gathered prefix KV live whole on every chip).  On a
+single chip every ``bytes_per_device`` equals ``bytes``, so the tp=1
+payload carries the same numbers it always did, plus the new keys.
 """
 
 from __future__ import annotations
@@ -48,21 +60,40 @@ class HbmLedger:
 
     def __init__(self):
         self._static: Dict[str, int] = {}
+        self._static_per_device: Dict[str, int] = {}
         self._gauges: Dict[str, Callable[[], int]] = {}
+        self._gauge_per_device: Dict[str, Callable[[], int]] = {}
         self._gauge_high: Dict[str, int] = {}
         self._workspace = 0
         self._workspace_high = 0
+        self._devices = 1
 
-    def set_static(self, name: str, nbytes: int) -> None:
+    def set_devices(self, n: int) -> None:
+        """Record the mesh size the per-device figures divide over
+        (engine init; 1 = single chip)."""
+        self._devices = max(1, int(n))
+
+    def set_static(self, name: str, nbytes: int,
+                   per_device: Optional[int] = None) -> None:
         """Record a category whose size is fixed for the engine's life
-        (weights, the KV reservation)."""
+        (weights, the KV reservation).  `per_device` is the resident
+        bytes on EACH mesh device (None = fully replicated: the whole
+        category on every chip)."""
         self._static[name] = int(nbytes)
+        self._static_per_device[name] = int(
+            nbytes if per_device is None else per_device
+        )
 
-    def gauge(self, name: str, fn: Callable[[], int]) -> None:
+    def gauge(self, name: str, fn: Callable[[], int],
+              per_device_fn: Optional[Callable[[], int]] = None) -> None:
         """Register a live category.  `fn` is called only at snapshot —
         it must be sync-free (host-side counter math, e.g. allocator
-        used-blocks x per-block bytes)."""
+        used-blocks x per-block bytes).  `per_device_fn` reports the
+        per-mesh-device share (None = replicated: fn's value on every
+        chip)."""
         self._gauges[name] = fn
+        if per_device_fn is not None:
+            self._gauge_per_device[name] = per_device_fn
         self._gauge_high.setdefault(name, 0)
 
     def note_workspace(self, nbytes: int) -> None:
@@ -77,7 +108,10 @@ class HbmLedger:
     def snapshot(self) -> Dict[str, Any]:
         cats: Dict[str, Dict[str, Any]] = {}
         for name, nbytes in self._static.items():
-            cats[name] = {"bytes": nbytes, "high_bytes": nbytes,
+            cats[name] = {"bytes": nbytes,
+                          "bytes_per_device":
+                              self._static_per_device.get(name, nbytes),
+                          "high_bytes": nbytes,
                           "static": True}
         for name, fn in self._gauges.items():
             try:
@@ -86,17 +120,31 @@ class HbmLedger:
                 # A gauge reading engine internals mid-teardown may see
                 # a half-built object; report what we can.
                 n = 0
+            pfn = self._gauge_per_device.get(name)
+            if pfn is None:
+                per_dev = n
+            else:
+                try:
+                    per_dev = int(pfn())
+                except (TypeError, ValueError, AttributeError, KeyError):
+                    per_dev = 0
             if n > self._gauge_high.get(name, 0):
                 self._gauge_high[name] = n
             cats[name] = {"bytes": n,
+                          "bytes_per_device": per_dev,
                           "high_bytes": self._gauge_high[name],
                           "static": False}
         cats["workspace"] = {"bytes": self._workspace,
+                             "bytes_per_device": self._workspace,
                              "high_bytes": self._workspace_high,
                              "static": False}
         return {
             "categories": cats,
+            "devices": self._devices,
             "total_bytes": sum(c["bytes"] for c in cats.values()),
+            "total_bytes_per_device": sum(
+                c["bytes_per_device"] for c in cats.values()
+            ),
             "total_high_bytes": sum(c["high_bytes"] for c in cats.values()),
         }
 

@@ -56,6 +56,7 @@ class JAXServer(SeldonComponent):
         weight_dtype: str = "",
         act_dtype: str = "",
         mesh_sp: int = 0,
+        tp: int = 0,
         prefix_cache: int = -1,
         prefix_cache_mb: int = 0,
         chunked_prefill: int = -1,
@@ -82,11 +83,27 @@ class JAXServer(SeldonComponent):
         # attn_impl=="ring", admissions prefill with the sequence
         # sharded over 'sp' (ring attention); 0 = no sp axis.
         self.mesh_sp = int(mesh_sp)
+        # graftmesh exact tensor parallelism (servers/mesh_engine.py +
+        # models/tp_sharding.py): unit parameter, or TP env. 0 (the
+        # default) keeps the legacy auto mesh plan; 1 pins an explicit
+        # single-chip mesh (the bit-exact reference leg mesh-audit
+        # compares against); tp > 1 builds a dedicated 'tp' mesh over
+        # the first tp devices (MESH_DEVICES env caps the claimable
+        # count) and shards weights + KV under the exact-TP table —
+        # greedy output stays bit-identical to tp=1. Mutually exclusive
+        # with mesh_sp (ring attention is not tp-threaded; the engine
+        # also rejects attn_impl=ring/flash).
         # Overrides the checkpoint config's weight_dtype: HF checkpoints
         # are always bf16 on disk, so serving them int8 (the llama3-8b-
         # on-one-16GB-chip config) is selected HERE (or via the
         # weight_dtype unit parameter / WEIGHT_DTYPE env).
         import os as _os
+
+        self.tp = int(tp or _os.environ.get("TP", "0") or 0)
+        if self.tp > 1 and self.mesh_sp > 1:
+            raise ValueError(
+                f"tp={self.tp} and mesh_sp={self.mesh_sp} are mutually "
+                "exclusive (ring attention is not tp-threaded)")
 
         self.weight_dtype = (
             weight_dtype or _os.environ.get("WEIGHT_DTYPE", "")
@@ -226,7 +243,7 @@ class JAXServer(SeldonComponent):
                     mesh_holder = {}
 
                     def _shardings(loaded_cfg):
-                        mesh_holder["mesh"] = self._mesh_for(loaded_cfg)
+                        mesh_holder["mesh"] = self._serving_mesh(loaded_cfg)
                         return shd.named_shardings(
                             mesh_holder["mesh"],
                             shd.param_pspecs(loaded_cfg),
@@ -237,7 +254,7 @@ class JAXServer(SeldonComponent):
                     )
                     mesh = mesh_holder["mesh"]
                 else:
-                    mesh = self._mesh_for(ckpt.load_config(local))
+                    mesh = self._serving_mesh(ckpt.load_config(local))
                     params, cfg = ckpt.load_checkpoint(local, mesh)
             else:
                 cfg = get_config(self.preset)
@@ -248,7 +265,7 @@ class JAXServer(SeldonComponent):
                         eos_token_id=self.tokenizer.eos_token_id,
                         pad_token_id=self.tokenizer.pad_token_id,
                     )
-                mesh = self._mesh_for(cfg)
+                mesh = self._serving_mesh(cfg)
                 with mesh:
                     params = jax.jit(
                         lambda k: transformer.init_params(cfg, k),
@@ -356,6 +373,12 @@ class JAXServer(SeldonComponent):
                 ekw["max_queue"] = self.max_queue
             if self.default_deadline_ms:
                 ekw["default_deadline_ms"] = self.default_deadline_ms
+            if self.tp > 1:
+                # The engine re-commits the params under the exact-TP
+                # table (models/tp_sharding) on the mesh
+                # _serving_mesh built — init/load placement above is
+                # just a staging layout.
+                ekw["tp"] = self.tp
             self.engine = InferenceEngine(
                 params,
                 cfg,
@@ -408,6 +431,19 @@ class JAXServer(SeldonComponent):
                 self.max_slots,
                 seq,
             )
+
+    def _serving_mesh(self, cfg):
+        """The mesh load() commits onto: a dedicated tp-wide 'tp' mesh
+        when the graftmesh knob is set (first tp devices, MESH_DEVICES-
+        capped), the auto TPxDP plan otherwise. tp=1 is meaningful —
+        an explicit single-chip mesh, the bit-exact reference leg the
+        mesh-audit parity gate compares a TP group against — while
+        tp=0 (the default) keeps the legacy auto plan."""
+        if self.tp >= 1:
+            from seldon_tpu.servers import mesh_engine
+
+            return mesh_engine.build_tp_mesh(self.tp)
+        return self._mesh_for(cfg)
 
     def _mesh_for(self, cfg):
         import math

@@ -1,0 +1,144 @@
+"""graftmesh: the tensor-parallel paged serving engine on the TP mesh.
+
+This module is the serving-side face of the exact-TP scheme in
+``models/tp_sharding.py``: it builds the one-axis ``('tp',)`` mesh the
+scheme commits onto and wraps :class:`InferenceEngine` so a caller (the
+JAXServer ``tp`` knob, ``make mesh-audit``, bench's BENCH_MESH legs)
+can stand up a TP group in one line::
+
+    mesh = mesh_engine.build_tp_mesh(2)
+    eng = mesh_engine.MeshEngine(params, cfg, EngineConfig(...), tp=2)
+
+Everything that makes TP serving *work* lives elsewhere on purpose —
+the sharding tables and constraint hints in ``models/tp_sharding.py``,
+the per-impl threading in ``servers/engine.py``, the per-chip pricing
+in ``servers/cost_model.py`` — because ``tp`` is a **config axis**
+(``EngineConfig.tp``), not a property of this class: the Nitsum
+groundwork is per-tier TP groups routed on ``deadline_ms``, where one
+process holds a tp=4 engine for the tight-deadline tier next to a tp=1
+engine for batch, each a plain ``InferenceEngine`` with a different
+config. ``MeshEngine`` is the convenience shell that pairs the config
+with a freshly built mesh; it adds no serving behavior.
+
+Device budget: ``build_tp_mesh`` claims the first ``tp`` addressable
+devices, capped by the ``MESH_DEVICES`` env (operator guard for
+sharing a host between engines — e.g. ``MESH_DEVICES=4`` keeps a tp=2
+engine off the back half of a v5e-8). CPU CI exercises real 8-device
+meshes via ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+(tests/conftest.py), so every mesh path here is covered without a TPU.
+
+The scheduler, lifecycle layer, prefix trie and every audit surface
+run UNCHANGED above a TP engine: SPMD partitioning happens inside each
+jitted dispatch, so the shape lattice — and therefore the compile
+ledger, sched ledger, pilot and roofline — see exactly the tp=1 keys.
+One sealed lattice serves the whole group (``/debug/compile`` carries
+``tp``/``mesh_devices`` so mesh-audit can assert it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+from seldon_tpu.models.config import ModelConfig
+from seldon_tpu.models.tp_sharding import TP_AXIS, validate
+from seldon_tpu.parallel.mesh import MeshPlan, make_mesh
+from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+
+def device_budget() -> int:
+    """Addressable devices graftmesh may claim: ``len(jax.devices())``
+    capped by the MESH_DEVICES env (0 / unset = no cap)."""
+    n = len(jax.devices())
+    try:
+        cap = int(os.environ.get("MESH_DEVICES", "0") or 0)
+    except ValueError:
+        logger.warning("MESH_DEVICES=%r is not an int; ignoring",
+                       os.environ.get("MESH_DEVICES"))
+        cap = 0
+    return min(n, cap) if cap > 0 else n
+
+
+def build_tp_mesh(tp: int, devices: Optional[List[Any]] = None) -> Mesh:
+    """Mesh with a ``tp``-wide 'tp' axis over the first ``tp`` devices
+    (every other axis of the standard vocabulary sized 1, so legacy
+    checkpoint-loading specs still resolve on it).
+
+    Device order is ``jax.devices()`` order — on a real slice that is
+    the ICI-adjacent enumeration, which is exactly what a TP group
+    wants (the 'tp' axis is innermost in the mesh vocabulary for the
+    same reason, parallel/mesh.AXES). An explicit ``devices`` list
+    overrides for callers packing several groups onto one host.
+    """
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if devices is None:
+        budget = device_budget()
+        if tp > budget:
+            raise ValueError(
+                f"tp={tp} needs {tp} devices but only {budget} are "
+                f"available (len(jax.devices()) capped by MESH_DEVICES)")
+        devices = jax.devices()[:tp]
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices, got {len(devices)}")
+    return make_mesh(MeshPlan(tp=tp), devices[:tp])
+
+
+class MeshEngine(InferenceEngine):
+    """:class:`InferenceEngine` stood up on a TP mesh it builds itself.
+
+    ``tp`` may come as the keyword here or already set on the engine
+    config; the keyword wins when both are given and they disagree is
+    an error (a mismatch means the caller's intent is ambiguous).
+    tp=1 degenerates to a plain single-chip engine with no mesh — the
+    byte-identical baseline every parity gate compares against.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: ModelConfig,
+        engine_cfg: Optional[EngineConfig] = None,
+        mesh: Optional[Mesh] = None,
+        draft: Optional[Tuple[Any, ModelConfig]] = None,
+        tp: int = 0,
+    ):
+        ecfg = engine_cfg or EngineConfig()
+        tp = int(tp)
+        if tp and ecfg.tp > 1 and tp != ecfg.tp:
+            raise ValueError(
+                f"MeshEngine(tp={tp}) disagrees with "
+                f"EngineConfig.tp={ecfg.tp}")
+        tp = tp or ecfg.tp
+        if ecfg.tp != tp:
+            ecfg = dataclasses.replace(ecfg, tp=tp)
+        if tp > 1:
+            validate(cfg, tp)  # fail before any devices are claimed
+            if mesh is None:
+                mesh = build_tp_mesh(tp)
+        super().__init__(params, cfg, ecfg, mesh=mesh, draft=draft)
+
+    def mesh_info(self) -> Dict[str, Any]:
+        """Host-side description of the TP group (no device sync):
+        group size, the devices backing it, and the per-device weight
+        bytes actually committed (counted from shard shapes)."""
+        if self._tp is None:
+            return {"tp": 1, "axis": TP_AXIS, "devices": [],
+                    "weight_bytes_per_device":
+                        self._hbm_weights_device_bytes()}
+        return {
+            "tp": self._tp.tp,
+            "axis": TP_AXIS,
+            "devices": [str(d) for d in
+                        self._tp.mesh.devices.reshape(-1)],
+            "weight_bytes_per_device": self._hbm_weights_device_bytes(),
+        }

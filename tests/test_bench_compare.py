@@ -106,6 +106,15 @@ def test_direction_heuristic():
     # predicted_vs_measured_req_s rides the req_s substring: a run that
     # lands closer to its roofline prediction gates higher-is-better.
     assert d("detail.predicted_vs_measured_req_s") == "higher"
+    # graftmesh: per-chip HBM gates lower (sharding is supposed to save
+    # it), the sharding-dividend fraction gates lower, the TP-leg
+    # throughput rides the req_per_s/tok_s substrings, and the mesh
+    # size itself is a config constant — informational.
+    assert d("detail.mesh.mesh.kv_bytes_per_device") == "lower"
+    assert d("detail.mesh.mesh.weights_bytes_per_device") == "lower"
+    assert d("detail.mesh.kv_per_device_frac") == "lower"
+    assert d("detail.mesh.mesh.req_per_s") == "higher"
+    assert d("detail.mesh.hbm_devices") == "info"
 
 
 # ---------------------------------------------------------------------------

@@ -123,6 +123,75 @@ def test_admit_key_hand_counted():
 
 
 # ---------------------------------------------------------------------------
+# graftmesh: per-chip closed forms at tp=2, hand-counted
+# ---------------------------------------------------------------------------
+# Exact-TP split (models/tp_sharding): qkv + gate/up shard their output
+# dim, o / down / embeddings / lm_head replicate. Per layer per chip:
+# qkv 8192/2 = 4096, o 4096, gate+up 2*64*128/2 = 8192, down 8192
+# -> 24576 params.
+
+
+def test_tp2_per_layer_hand_counted():
+    assert cost_model.matmul_params_per_layer(TINY, 2) == 24576
+
+
+def test_tp2_flops_per_token_hand_counted():
+    # 2 * (2 layers * 24576 + lm_head 64*256 replicated) = 131072
+    assert cost_model.flops_per_token(TINY, 2) == 131072
+
+
+def test_tp2_attn_and_kv_hand_counted():
+    # Heads shard on 'tp': per-chip attention and KV both halve.
+    assert cost_model.attn_flops(TINY, 1, 64, tp=2) == 16384
+    assert cost_model.kv_bytes_per_token(TINY, 2) == 128
+
+
+def test_tp2_weight_bytes_hand_counted():
+    # matmuls 2*24576*2B + embedding 32768 + lm_head 32768 (both full
+    # on every chip) = 163840
+    assert cost_model.weight_bytes(TINY, 2) == 163840
+
+
+def test_tp2_decode_key_hand_counted():
+    flops, bytes_ = cost_model.cost_of_key(("decode", 8), TINY,
+                                           tp=2, **GEOM)
+    assert flops == 8 * 4 * (131072 + 16384) == 4718592
+    assert bytes_ == 8 * (163840 + 4 * 64 * 128 + 4 * 128) == 1576960
+
+
+def test_tp1_default_unchanged():
+    # The tp kwarg defaults to 1 and must price exactly the seed
+    # numbers — the tp=1 path is byte-identical to a build without
+    # graftmesh.
+    assert cost_model.matmul_params_per_layer(TINY, 1) == 36864
+    assert (cost_model.cost_of_key(("decode", 8), TINY, tp=1, **GEOM)
+            == cost_model.cost_of_key(("decode", 8), TINY, **GEOM))
+
+
+def test_tp_moe_shards_attention_only():
+    # MoE expert weights replicate (expert_out contracts d_ff — a psum
+    # would break exactness), so only the qkv term divides.
+    moe = get_config("tiny-moe")
+    full = cost_model.matmul_params_per_layer(moe, 1)
+    half = cost_model.matmul_params_per_layer(moe, 2)
+    assert full - half == 8192 - 4096  # qkv/2 is the only delta
+
+
+def test_roof_ledger_binds_tp():
+    led = cost_model.RoofLedger()
+    led.bind(TINY, tp=2, **GEOM)
+    snap = led.snapshot()
+    assert snap["tp"] == 2
+    # The bound geometry threads into every priced key.
+    assert led._cost(("decode", 8)) == cost_model.cost_of_key(
+        ("decode", 8), TINY, tp=2, **GEOM)
+    # Default bind stays tp=1 — the seed schema payload, plus the key.
+    led2 = cost_model.RoofLedger()
+    led2.bind(TINY, **GEOM)
+    assert led2.snapshot()["tp"] == 1
+
+
+# ---------------------------------------------------------------------------
 # Family coverage pinned to the lattice
 # ---------------------------------------------------------------------------
 

@@ -27,6 +27,8 @@ from seldon_tpu.servers.sched_ledger import SchedLedger
 # The documented /debug/compile schema, frozen.
 COMPILE_TOP_KEYS = frozenset({
     "warmup_complete",
+    "tp",
+    "mesh_devices",
     "declared_variants",
     "dispatched_variants",
     "warmup_coverage",
@@ -41,8 +43,13 @@ COMPILE_LATTICE_KEYS = frozenset({
 })
 
 # The documented /debug/hbm schema, frozen.
-HBM_TOP_KEYS = frozenset({"categories", "total_bytes", "total_high_bytes"})
-HBM_CATEGORY_KEYS = frozenset({"bytes", "high_bytes", "static"})
+HBM_TOP_KEYS = frozenset({
+    "categories", "devices", "total_bytes", "total_bytes_per_device",
+    "total_high_bytes",
+})
+HBM_CATEGORY_KEYS = frozenset({
+    "bytes", "bytes_per_device", "high_bytes", "static",
+})
 
 # The documented /debug/sched schema, frozen (tools/sched_audit.py
 # carries the same top-level golden).
@@ -133,6 +140,7 @@ ROOF_TOP_KEYS = frozenset({
     "enabled",
     "platform",
     "peaks",
+    "tp",
     "boundaries",
     "waves",
     "step",

@@ -71,7 +71,9 @@ def rules(findings):
 def test_grid_closed_form_matches_simulation():
     specs = shape_lattice.grid()
     # 8 flag combos x 4 bucket shapes + 2 ragged combos x 4 shapes
-    assert len(specs) == 40
+    # + 4 spec combos x 2 shapes (graftspec grew the grid but not
+    # this pin)
+    assert len(specs) == 48
     for spec in specs:
         holes, waste = shape_lattice.check_spec(spec)
         assert holes == [], (spec, holes)
@@ -459,6 +461,45 @@ def test_shard_axis_skipped_without_axes_decl(tmp_path):
     assert lint(tmp_path, src, [shardcheck.run]) == []
 
 
+AXIS_ALIAS_BAD = """
+    from jax.sharding import PartitionSpec
+
+    AXES = ("dp", "tp")
+    TP_AXIS = "tensor"
+"""
+
+AXIS_ALIAS_OK = """
+    from jax.sharding import PartitionSpec
+
+    AXES = ("dp", "tp")
+    TP_AXIS = AXES[-1]
+    DP_AXIS = "dp"
+"""
+
+AXIS_ALIAS_EXEMPT = """
+    # Not a sharding file (no PartitionSpec/shard_map import): an _AXIS
+    # constant here is not a mesh-axis alias.
+    AXES = ("dp", "tp")
+    RULE_AXIS = "shard-axis"
+"""
+
+
+def test_shard_axis_string_alias_outside_vocabulary(tmp_path):
+    # graftmesh drift guard: a module-level *_AXIS alias re-declared as
+    # a raw string must still name a declared mesh axis.
+    fs = lint(tmp_path, AXIS_ALIAS_BAD, [shardcheck.run])
+    assert rules(fs) == ["shard-axis"]
+    assert "TP_AXIS" in fs[0].message and '"tensor"' in fs[0].message
+
+
+def test_shard_axis_alias_derived_or_in_vocabulary_clean(tmp_path):
+    assert lint(tmp_path, AXIS_ALIAS_OK, [shardcheck.run]) == []
+
+
+def test_shard_axis_alias_non_sharding_file_exempt(tmp_path):
+    assert lint(tmp_path, AXIS_ALIAS_EXEMPT, [shardcheck.run]) == []
+
+
 def test_shard_host_pull_on_tainted_locals(tmp_path):
     fs = lint(tmp_path, PULL_BAD, [shardcheck.run])
     assert rules(fs) == ["shard-host-pull"]
@@ -470,6 +511,27 @@ def test_shard_host_pull_on_tainted_locals(tmp_path):
 
 def test_shard_host_pull_untainted_clean(tmp_path):
     assert lint(tmp_path, PULL_OK, [shardcheck.run]) == []
+
+
+PULL_TP_SHARDERS = """
+    import numpy as np
+
+    def g(mesh, cfg, params, state, tp_sharding):
+        p = tp_sharding.shard_params(mesh, cfg, params)
+        s = tp_sharding.shard_state(mesh, state)
+        a = np.asarray(p)
+        b = s.item()
+        return a, b
+"""
+
+
+def test_shard_host_pull_on_tp_sharder_results(tmp_path):
+    # graftmesh: shard_params / shard_state return NamedSharding-pinned
+    # trees; pulling them to the host gathers the whole TP group.
+    fs = lint(tmp_path, PULL_TP_SHARDERS, [shardcheck.run])
+    assert rules(fs) == ["shard-host-pull"]
+    pulled = " | ".join(f.message for f in fs)
+    assert "asarray(p)" in pulled and "s.item()" in pulled
 
 
 def test_shard_jit_without_shardings_in_sharding_file(tmp_path):
@@ -488,6 +550,21 @@ def test_shard_jit_engine_style_file_exempt(tmp_path):
 @pytest.mark.lint
 def test_real_parallel_tree_is_shard_clean():
     files = core.load_tree([REPO / "seldon_tpu" / "parallel"], REPO)
+    fs = shardcheck.run(files, core.Context(REPO))
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.lint
+def test_real_graftmesh_layer_is_shard_clean():
+    # The TP serving layer is scanned TOGETHER with parallel/ so its
+    # P(...) specs and collectives are held to the real mesh.AXES
+    # vocabulary (the axes declaration lives in parallel/mesh.py), and
+    # the baseline stays empty — no waivers in the sharded layer.
+    files = core.load_tree(
+        [REPO / "seldon_tpu" / "parallel",
+         REPO / "seldon_tpu" / "models" / "tp_sharding.py",
+         REPO / "seldon_tpu" / "servers" / "mesh_engine.py",
+         REPO / "seldon_tpu" / "servers" / "engine.py"], REPO)
     fs = shardcheck.run(files, core.Context(REPO))
     assert fs == [], "\n".join(f.render() for f in fs)
 

@@ -51,10 +51,14 @@ def test_static_gauge_and_workspace_accounting():
 
     snap = led.snapshot()
     cats = snap["categories"]
-    assert cats["weights"] == {"bytes": 1000, "high_bytes": 1000,
-                               "static": True}
-    assert cats["kv_live"] == {"bytes": 0, "high_bytes": 0, "static": False}
+    assert cats["weights"] == {"bytes": 1000, "bytes_per_device": 1000,
+                               "high_bytes": 1000, "static": True}
+    assert cats["kv_live"] == {"bytes": 0, "bytes_per_device": 0,
+                               "high_bytes": 0, "static": False}
     assert "workspace" in cats
+    # Single-chip ledger: per-device == full for every category.
+    assert snap["devices"] == 1
+    assert snap["total_bytes_per_device"] == snap["total_bytes"]
 
     # Gauge rises: bytes track it, high ratchets.
     live["n"] = 700

@@ -12,11 +12,14 @@ Every numeric scalar in the metric line is flattened to a dot path
 and compared base -> candidate with a direction heuristic:
 
  * lower-is-better:  names containing ``ms``, ``latency``, ``stall``,
-   ``frag``, ``dropped``, ``error``, plus the exact waste metrics
-   ``padding_waste_frac`` / ``goodput_gap`` (the sched ledger's
-   lost-capacity fractions — checked before the ``goodput`` substring
-   would claim them as higher-is-better) and graftroof's ``host_frac``
-   (scheduler overhead share of the boundary wall);
+   ``frag``, ``dropped``, ``error``, ``bytes_per_device`` (graftmesh:
+   per-chip HBM the TP sharding is supposed to save), plus the exact
+   waste metrics ``padding_waste_frac`` / ``goodput_gap`` (the sched
+   ledger's lost-capacity fractions — checked before the ``goodput``
+   substring would claim them as higher-is-better), graftroof's
+   ``host_frac`` (scheduler overhead share of the boundary wall), and
+   graftmesh's ``kv_per_device_frac`` (TP-leg per-chip KV bytes over
+   the single-chip leg's — ~1/tp when the pool shards);
  * higher-is-better: names containing ``req_per_s``, ``req_s``,
    ``tokens_per_s``, ``tok_s``, ``speedup``, ``hit_rate``, ``goodput``,
    ``coverage``, ``acceptance_rate`` (graftspec: a better drafter keeps
@@ -54,7 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # Substring -> direction tables, checked against the LAST path segment
 # so "detail.chunked.p50_ttft_ms" gates on "p50_ttft_ms".
 _LOWER = ("ms", "latency", "stall", "frag", "dropped", "error",
-          "inversions")
+          "inversions", "bytes_per_device")
 _HIGHER = ("req_per_s", "req_s", "tokens_per_s", "tok_s", "speedup",
            "hit_rate", "goodput", "coverage", "acceptance_rate")
 # Exact leaf-name matches for the headline numbers. graftroof's
@@ -67,8 +70,12 @@ _HIGHER_EXACT = ("value", "vs_baseline", "mfu", "mbu")
 # "dispatch_per_token" is graftspec's compression metric — verify waves
 # emitting more tokens per dispatch push it DOWN. "host_frac" is
 # graftroof's scheduler-overhead share of the boundary wall.
+# "kv_per_device_frac" is graftmesh's sharding dividend — the TP leg's
+# per-chip KV bytes as a fraction of the single-chip leg's; exact-TP
+# splits the head axis, so it should sit at ~1/tp and only rise if a
+# regression stops the pool from sharding.
 _LOWER_EXACT = ("padding_waste_frac", "goodput_gap", "dispatch_per_token",
-                "host_frac")
+                "host_frac", "kv_per_device_frac")
 # Model-side constants, never gated: "roof_predicted_req_s" moves when
 # the COST MODEL changes, not when the served binary regresses.
 _INFO_EXACT = ("roof_predicted_req_s",)

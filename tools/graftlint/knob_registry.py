@@ -77,6 +77,20 @@ KNOBS = {
                      "target): loads a resident draft model and "
                      "compiles the (\"draft\", k) ladder; empty uses "
                      "the zero-cost host n-gram drafter."),
+    "TP": _k("engine-serving", "0 (legacy auto mesh)",
+             "graftmesh tensor-parallel group size. 0 keeps the legacy "
+             "auto mesh; 1 pins an explicit single-chip ('tp',) mesh — "
+             "the bit-exact parity reference every TP gate compares "
+             "against; N>1 shards weights and the paged KV pool over N "
+             "devices (exact-TP: greedy output stays bit-identical to "
+             "tp=1). Requires tp | n_kv_heads, n_heads, d_ff; mutually "
+             "exclusive with mesh_sp>1."),
+    "MESH_DEVICES": _k("engine-serving", "0 (no cap)",
+                       "Caps the devices graftmesh may claim "
+                       "(device_budget()); operator guard for sharing "
+                       "a host between engines — e.g. MESH_DEVICES=4 "
+                       "keeps a tp=2 engine off the back half of a "
+                       "v5e-8."),
     "MAX_QUEUE": _k("engine-serving", "0 (unbounded)",
                     "Admission queue bound; past it submit() sheds with "
                     "a retriable 429 EngineOverloaded."),
@@ -373,6 +387,17 @@ KNOBS = {
                            "— the acceptance upper bound), empty for "
                            "the host n-gram drafter, or a preset name "
                            "for a resident draft model."),
+    "BENCH_MESH": _k("bench-harness", "0",
+                     "Run the graftmesh phase: the same greedy ragged "
+                     "closed wave tp=BENCH_MESH_TP vs single-chip at "
+                     "EQUAL engine config, asserting bit-identical "
+                     "streams and recording per-device HBM "
+                     "(bench_compare gates bytes_per_device and "
+                     "kv_per_device_frac lower-is-better). On fake "
+                     "devices the speedup is not meaningful; the parity "
+                     "and sharding-dividend record is."),
+    "BENCH_MESH_TP": _k("bench-harness", "2",
+                        "TP group size for the mesh phase leg."),
     "BENCH_SLO": _k("bench-harness", "1 for bench-1b, else 0",
                     "Run the TTFT SLO search phase."),
     "BENCH_SLO_CHUNK": _k("bench-harness", "0 (adaptive)",

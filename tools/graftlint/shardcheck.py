@@ -14,7 +14,11 @@ Three rules:
     literal, an ``axis_name=``/``axis_names=`` kwarg, or a
     ``lax.p*`` collective's first string argument must be declared in
     the scanned tree's ``AXES`` tuple (the mesh-axis vocabulary;
-    skipped when no scanned file declares one).
+    skipped when no scanned file declares one).  Module-level axis
+    aliases (``FOO_AXIS = "..."``) are held to the same vocabulary:
+    graftmesh's ``models/tp_sharding.py`` derives ``TP_AXIS`` from
+    ``AXES[-1]`` precisely so it cannot drift, and a re-declared
+    string alias elsewhere would undo that.
 
 ``shard-host-pull``
     ``.item()`` / ``np.asarray()`` / ``np.array()`` / ``float()`` /
@@ -50,8 +54,12 @@ _COLLECTIVES = {
     "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "pswapaxes",
     "axis_index", "all_gather", "all_to_all", "psum_scatter", "pcast",
 }
-# Call names whose result lives sharded on device.
-_SHARDED_SOURCES = {"shard_map", "device_put", "shard_tree", "make_array"}
+# Call names whose result lives sharded on device. shard_params /
+# shard_state are graftmesh's tp_sharding sharders: their return values
+# are NamedSharding-committed trees, so a host pull on them gathers the
+# whole TP group's weights or KV state through one host.
+_SHARDED_SOURCES = {"shard_map", "device_put", "shard_tree", "make_array",
+                    "shard_params", "shard_state"}
 _HOST_PULLS = {"asarray", "array"}  # np.<name>(tainted)
 
 
@@ -109,6 +117,36 @@ def run(files: List[core.SourceFile], ctx: core.Context) -> List[core.Finding]:
     for sf in files:
         core.attach_parents(sf.tree)
         sharding_file = _uses_sharding(sf)
+
+        # -- shard-axis: module-level FOO_AXIS = "..." aliases -----------
+        # tp_sharding derives TP_AXIS from AXES[-1] (a Subscript, never
+        # flagged); only a raw string re-declaration can drift, and that
+        # is exactly the misspelled-axis failure shape at its root.
+        # Scoped to sharding-centric files: an _AXIS constant in a file
+        # that never names a PartitionSpec (e.g. this pass's own
+        # RULE_AXIS) is not a mesh-axis alias.
+        if axes is not None and sharding_file:
+            for node in sf.tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id.endswith("_AXIS")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    continue
+                if node.value.value in axes:
+                    continue
+                if core.allowed(sf, RULE_AXIS, node.lineno):
+                    continue
+                findings.append(core.make_finding(
+                    sf, RULE_AXIS, node.lineno,
+                    f"axis alias {node.targets[0].id} = "
+                    f"\"{node.value.value}\" names an axis outside the "
+                    f"declared mesh vocabulary {tuple(sorted(axes))}",
+                    hint="derive the alias from mesh.AXES (e.g. "
+                         "TP_AXIS = AXES[-1]) so it cannot drift",
+                ))
+
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue

@@ -46,7 +46,7 @@ import sys
 # same goldens; a mismatch here means the snapshot schema changed
 # without updating its consumers.
 ROOF_TOP_KEYS = frozenset({
-    "enabled", "platform", "peaks", "boundaries", "waves", "step",
+    "enabled", "platform", "peaks", "tp", "boundaries", "waves", "step",
     "host_frac", "device_frac", "conservation", "variants", "totals",
 })
 ROOF_VARIANT_KEYS = frozenset({
