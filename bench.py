@@ -1175,7 +1175,13 @@ def _measure_ragged(params, cfg) -> dict:
     that prediction on the same wave: the report carries per-leg req/s
     + padding_waste_frac, the ragged leg's compile-variant count
     (collapse contract: ≤ 2, gated strictly by bench_compare), and
-    ragged_vs_roofline — measured over predicted."""
+    ragged_vs_roofline — measured over predicted.
+
+    graftkern adds the kernel axis: the same wave re-run GREEDY per
+    RAGGED_KERNEL leg (masked vs sparse — greedy because that is the
+    legs' token-identity contract), token streams asserted bit-equal,
+    with detail.ragged.kernel carrying per-leg req/s plus the gated
+    sparse_vs_masked_speedup / sparse_vs_bucketed_speedup ratios."""
     import numpy as np
 
     from seldon_tpu.models.sampling import SamplingParams
@@ -1199,7 +1205,7 @@ def _measure_ragged(params, cfg) -> dict:
         for i in range(n_req)
     ]
 
-    def leg(ragged: bool):
+    def leg(ragged: bool, kernel: str = "masked", greedy: bool = False):
         ecfg = EngineConfig(
             max_slots=slots,
             max_seq_len=smax,
@@ -1209,22 +1215,27 @@ def _measure_ragged(params, cfg) -> dict:
             paged_kv=True, kv_block=bs, kv_pool_blocks=pool_blocks,
             chunked_prefill=True, prefill_chunk=chunk, prefix_block=bs,
             ragged=ragged,
+            ragged_kernel=kernel if ragged else "masked",
         )
         engine = InferenceEngine(params, cfg, ecfg)
         engine.warmup()
         engine.start()
         t0 = time.perf_counter()
         qs = [engine.submit(p, SamplingParams(
-                  temperature=0.7, top_k=0, top_p=1.0,
+                  temperature=0.0 if greedy else 0.7, top_k=0, top_p=1.0,
                   max_new_tokens=new_toks, seed=i))
               for i, p in enumerate(prompts)]
+        streams = []
         for q in qs:
+            toks = []
             while True:
                 item = q.get(timeout=300)
                 if item is None:
                     break
                 if "error" in item:
                     raise RuntimeError(item["error"])
+                toks.extend(item.get("tokens", []))
+            streams.append(toks)
         dt = time.perf_counter() - t0
         req_s = n_req / dt
         out = {
@@ -1237,10 +1248,23 @@ def _measure_ragged(params, cfg) -> dict:
                            max_new=new_toks),
         }
         engine.stop()
-        return out
+        return out, streams
 
-    bucketed = leg(ragged=False)
-    ragged_leg = leg(ragged=True)
+    bucketed, _ = leg(ragged=False)
+    ragged_leg, _ = leg(ragged=True)
+    # graftkern kernel axis: the same wave greedy per kernel leg. The
+    # legs' contract is greedy token-identity, so the bit-parity assert
+    # IS part of the benchmark — a fast-but-wrong kernel must fail
+    # here, not ship a number.
+    kern_masked, want = leg(ragged=True, kernel="masked", greedy=True)
+    kern_sparse, got = leg(ragged=True, kernel="sparse", greedy=True)
+    if got != want:
+        raise RuntimeError(
+            "ragged kernel=sparse diverged from masked greedy stream")
+    # Greedy bucketed twin for the sparse-vs-bucketed ratio: greedy
+    # streams run to full max_new_tokens (no sampled-EOS early exits),
+    # so the ratio must compare legs doing identical token work.
+    kern_bucketed, _ = leg(ragged=False, greedy=True)
     roofline = bucketed.get("waste_roofline", {}).get(
         "ragged_attention_req_s", 0.0)
     return {
@@ -1255,6 +1279,21 @@ def _measure_ragged(params, cfg) -> dict:
         # the wave kernel itself still owes.
         "ragged_vs_roofline": (round(ragged_leg["req_per_s"] / roofline, 3)
                                if roofline else None),
+        "kernel": {
+            "masked": kern_masked,
+            "sparse": kern_sparse,
+            "bit_identical": True,
+            "sparse_vs_masked_speedup": (
+                round(kern_sparse["req_per_s"] / kern_masked["req_per_s"], 3)
+                if kern_masked["req_per_s"] else None),
+            "bucketed_greedy": kern_bucketed,
+            # vs the bucketed lattice at identical (greedy) token work:
+            # the graftragged padding loss the sparse walker un-does.
+            "sparse_vs_bucketed_speedup": (
+                round(kern_sparse["req_per_s"]
+                      / kern_bucketed["req_per_s"], 3)
+                if kern_bucketed["req_per_s"] else None),
+        },
     }
 
 

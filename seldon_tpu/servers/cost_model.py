@@ -293,7 +293,11 @@ def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
         return float(flops), float(bytes_)
     if fam == "ragged":
         # (tag, C): ONE fused wave priced at its static capacity
-        # max_slots * C — low packing reads as low MFU by design.
+        # max_slots * C. Since graftkern this is the CAPACITY figure
+        # (exported as capacity_* in /debug/roof): the ledger prices
+        # the live fields from per-wave descriptor occupancy
+        # (ragged_occupancy_cost via note_ragged_occupancy) when the
+        # engine feeds it, falling back to this bound otherwise.
         c = key[1] or ragged_chunk
         t = B * c
         flops = t * fpt + attn_flops(cfg, t, W, tp=tp)
@@ -316,6 +320,27 @@ def cost_of_key(key: Key, cfg, *, max_slots: int, max_seq_len: int,
                            max_seq_len=min(max_seq_len,
                                            draft_cfg.max_seq_len))
     raise ValueError(f"unknown dispatch family {fam!r} (key {key!r})")
+
+
+def ragged_occupancy_cost(cfg, *, q_tokens: int, kv_read_tokens: int,
+                          attn_qk: int, tp: int = 1) -> Tuple[float, float]:
+    """(flops, hbm_bytes) of ONE ragged wave priced at its LIVE
+    descriptor occupancy (graftkern): ``q_tokens`` query positions
+    actually packed (prefill segments + decode rows), ``attn_qk`` the
+    summed q*kv attention pairs those rows really score, and
+    ``kv_read_tokens`` the pool positions the block-sparse walk
+    gathers. This is what the sparse/pallas kernels — and, masked's
+    -1e30 columns aside, the useful arithmetic of every leg — actually
+    do, so MFU/MBU stop reading capacity padding as waste. The static
+    ``cost_of_key`` "ragged" formula stays exported as the capacity_*
+    fields (/debug/roof shows both)."""
+    tp = max(1, int(tp))
+    flops = q_tokens * flops_per_token(cfg, tp) \
+        + 4 * cfg.d_model * attn_qk * cfg.n_layers // tp
+    kvpt = kv_bytes_per_token(cfg, tp)
+    bytes_ = weight_bytes(cfg, tp) + kv_read_tokens * kvpt \
+        + q_tokens * kvpt
+    return float(flops), float(bytes_)
 
 
 # -- peaks ------------------------------------------------------------------
@@ -448,11 +473,20 @@ class RoofLedger:
         }
         self._platform = ""
         self._peaks = resolve_peaks("")
-        # key -> [dispatches, flops, bytes, device_ms, predicted_ms]
+        # key -> [dispatches, flops, bytes, device_ms, predicted_ms,
+        #         capacity_flops, capacity_bytes, capacity_predicted_ms]
+        # Live (slots 1-4) == capacity (slots 5-7) for every family
+        # except "ragged" waves fed live occupancy (graftkern).
         self._variants: Dict[Key, List[float]] = {}
         self._cost_cache: Dict[Key, Tuple[float, float]] = {}
         self._predict_cache: Dict[Tuple[int, int], float] = {}
         self._waves = 0
+        # Live ragged-wave occupancy FIFO: the engine notes each
+        # dispatched wave's (q_tokens, kv_read_tokens, attn_qk) under
+        # _book BEFORE its boundary prices (note_wave consumes oldest-
+        # first when it meets a "ragged" key). Empty -> ragged prices
+        # at capacity, so occupancy-blind engines are unchanged.
+        self._pending_occ: List[Tuple[int, int, int]] = []
         # Step decomposition accumulators (ms).
         self._boundaries = 0
         self._wall_ms = 0.0
@@ -506,35 +540,66 @@ class RoofLedger:
 
     # -- hot path (scheduler/fetcher thread, under _book) --------------------
 
+    def note_ragged_occupancy(self, q_tokens: int, kv_read_tokens: int,
+                              attn_qk: int) -> None:
+        """Queue one ragged wave's live descriptor occupancy (graftkern)
+        for the boundary that prices it. Called by _dispatch_ragged
+        under _book right before the jit call; note_wave pops FIFO when
+        it meets the wave's "ragged" key, so the pairing is exact as
+        long as every occupancy-noting dispatch reaches note_wave (a
+        drained/failed boundary leaves at most one stale entry, bounded
+        by the cap here)."""
+        if len(self._pending_occ) < 64:
+            self._pending_occ.append(
+                (int(q_tokens), int(kv_read_tokens), int(attn_qk))
+            )
+
     def note_wave(self, keys: List[Key], device_ms: float) -> None:
         """Join one boundary's dispatch keys with its measured device
         time: the wave's device_ms splits across its keys weighted by
         each key's roofline estimate (equal split when nothing prices),
-        so per-variant device time stays conserved across the wave."""
+        so per-variant device time stays conserved across the wave.
+
+        "ragged" keys price their LIVE fields from the engine-fed
+        occupancy queue (falling back to the static capacity formula
+        when it is empty); every key also accumulates the capacity
+        figures, identical to live for every other family."""
         if not keys:
             return
         self._waves += 1
-        ests = []
+        priced = []
         for key in keys:
-            flops, bytes_ = self._cost(key)
-            ests.append(roofline_ms(flops, bytes_, self._peaks))
-        total_est = sum(ests)
-        for key, est in zip(keys, ests):
+            cap_f, cap_b = self._cost(key)
+            cap_est = roofline_ms(cap_f, cap_b, self._peaks)
+            flops, bytes_, est = cap_f, cap_b, cap_est
+            if key[0] == "ragged" and self._pending_occ:
+                q, kv, qk = self._pending_occ.pop(0)
+                flops, bytes_ = ragged_occupancy_cost(
+                    self._cfg, q_tokens=q, kv_read_tokens=kv,
+                    attn_qk=qk, tp=self._geom["tp"],
+                )
+                est = roofline_ms(flops, bytes_, self._peaks)
+            priced.append((key, flops, bytes_, est, cap_f, cap_b,
+                           cap_est))
+        total_est = sum(p[3] for p in priced)
+        for key, flops, bytes_, est, cap_f, cap_b, cap_est in priced:
             share = (device_ms * est / total_est if total_est > 0.0
                      else device_ms / len(keys))
-            flops, bytes_ = self._cost(key)
             row = self._variants.get(key)
             if row is None and len(self._variants) >= _MAX_VARIANTS:
                 key = _OVERFLOW_KEY
                 row = self._variants.get(key)
             if row is None:
-                row = [0, 0.0, 0.0, 0.0, 0.0]
+                row = [0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0]
                 self._variants[key] = row
             row[0] += 1
             row[1] += flops
             row[2] += bytes_
             row[3] += share
             row[4] += est
+            row[5] += cap_f
+            row[6] += cap_b
+            row[7] += cap_est
 
     def note_step(self, host_pre_ms: float, device_ms: float,
                   host_post_ms: float, span_ms: float) -> None:
@@ -607,6 +672,7 @@ class RoofLedger:
             disp, flops, bytes_, dms, pred = (
                 int(v[0]), v[1], v[2], v[3], v[4]
             )
+            cap_f, cap_b, cap_pred = v[5], v[6], v[7]
             secs = dms / 1000.0
             mfu = min(1.0, flops / (secs * pf)) if secs > 0.0 else 0.0
             mbu = min(1.0, bytes_ / (secs * pb)) if secs > 0.0 else 0.0
@@ -624,6 +690,11 @@ class RoofLedger:
                 "bytes": bytes_,
                 "device_ms": round(dms, 3),
                 "predicted_ms": round(pred, 3),
+                # Static serving-shape bound (== live for every family
+                # except occupancy-fed ragged waves, graftkern).
+                "capacity_flops": cap_f,
+                "capacity_bytes": cap_b,
+                "capacity_predicted_ms": round(cap_pred, 3),
                 "mfu": round(mfu, 6),
                 "mbu": round(mbu, 6),
                 "bound": bound,

@@ -150,6 +150,20 @@ class EngineConfig:
     # byte-identical to the bucketed engine.
     ragged: bool = False
     ragged_chunk: int = 0  # per-slot tokens per wave; 0 -> prefill_chunk
+    # Ragged attention kernel leg (graftkern): "masked" = the bit-exact
+    # full-width baseline above; "sparse" = the block-sparse jnp walker
+    # (ops/ragged_paged_attention.py) that touches only live KV blocks
+    # and skips dead prefill legs — the CPU/default-perf leg; "pallas"
+    # = the Mosaic kernel for the same walk (interpret-mode on CPU).
+    # All legs compile into the SAME single ("ragged", C) variant.
+    # Greedy outputs are token-identical across legs; non-greedy
+    # sampling may diverge in ulps (masked is the any-temperature
+    # exactness leg). Also selects the spec verify_wave leg.
+    ragged_kernel: str = "masked"
+    # > 0: waves whose longest live row needs more than this many pool
+    # blocks run the masked leg via an in-trace lax.cond (never
+    # truncates, never adds a variant). 0 = no budget (sparse always).
+    ragged_block_budget: int = 0
     # Speculative decoding (opt-in; graftspec): a resident drafter
     # proposes up to `spec_k` tokens per live slot each wave and the
     # target model verifies all k+1 positions in ONE wide dispatch
@@ -290,6 +304,16 @@ class EngineConfig:
                     f"({self.kv_block}) so wave boundaries append whole "
                     f"pool blocks"
                 )
+        if self.ragged_kernel not in ("masked", "sparse", "pallas"):
+            raise ValueError(
+                f"ragged_kernel ({self.ragged_kernel!r}) must be one of "
+                f"'masked', 'sparse', 'pallas'"
+            )
+        if self.ragged_block_budget < 0:
+            raise ValueError(
+                f"ragged_block_budget ({self.ragged_block_budget}) must "
+                f"be >= 0 (0 = no budget)"
+            )
         if self.spec_decode:
             if not self.paged_kv:
                 raise ValueError(
@@ -963,9 +987,15 @@ class InferenceEngine:
                 self.ecfg.ragged_chunk or self._prefill_chunk,
                 max(self._buckets),
             )
+            # graftkern: the kernel leg is a Python constant closed over
+            # at jit time — swapping it swaps the trace, never the
+            # lattice key, so masked/sparse/pallas all stay inside the
+            # ONE ("ragged", C) variant.
             self._jit_ragged = jax.jit(
                 functools.partial(
-                    self._ragged_impl, cfg=self.cfg, mesh=mesh, **tpkw,
+                    self._ragged_impl, cfg=self.cfg, mesh=mesh,
+                    kernel=self.ecfg.ragged_kernel,
+                    block_budget=self.ecfg.ragged_block_budget, **tpkw,
                 ),
                 donate_argnums=(1,),
             )
@@ -1003,7 +1033,9 @@ class InferenceEngine:
             self._spec_k_live = self._spec_rungs[-1]  # graftlint: guarded-by(_book)
             self._jit_verify = jax.jit(
                 functools.partial(
-                    self._verify_impl, cfg=self.cfg, mesh=mesh, **tpkw,
+                    self._verify_impl, cfg=self.cfg, mesh=mesh,
+                    kernel=self.ecfg.ragged_kernel,
+                    block_budget=self.ecfg.ragged_block_budget, **tpkw,
                 ),
                 donate_argnums=(1,),
             )
@@ -1729,7 +1761,7 @@ class InferenceEngine:
     def _ragged_impl(
         params, state, table, tokens, plens, starts, seeds, temps,
         top_ks, top_ps, max_news, finals, is_prefill, *, cfg, mesh=None,
-        tp=None,
+        tp=None, kernel="masked", block_budget=0,
     ):
         """graftragged: the ONE unified wave — every slot's prefill
         segment of the flat token buffer plus one decode step for every
@@ -1743,6 +1775,7 @@ class InferenceEngine:
         state, first, first_done, toks, valid = ragged_attention.ragged_wave(
             params, state, table, tokens, plens, starts, seeds, temps,
             top_ks, top_ps, max_news, finals, is_prefill, cfg, tp=tp,
+            kernel=kernel, block_budget=block_budget,
         )
         if tp is not None:
             state = tp.constrain_state(state)
@@ -1753,7 +1786,8 @@ class InferenceEngine:
 
     @staticmethod
     def _verify_impl(params, state, table, drafts, wave, *, cfg,
-                     mesh=None, tp=None):
+                     mesh=None, tp=None, kernel="masked",
+                     block_budget=0):
         """graftspec: ONE wide verify dispatch replacing up to k + 1
         sequential decode steps (models/spec_decode.verify_wave). The
         k rung is carried by the drafts width — one compile per rung,
@@ -1761,7 +1795,8 @@ class InferenceEngine:
         exact contract (toks/valid are [k+1, B] True-prefix columns),
         so _process_chunk consumes a wave unchanged."""
         state, toks, valid = spec_model.verify_wave(
-            params, state, table, drafts, wave, cfg, tp=tp
+            params, state, table, drafts, wave, cfg, tp=tp,
+            kernel=kernel, block_budget=block_budget,
         )
         if tp is not None:
             state = tp.constrain_state(state)
@@ -3622,6 +3657,40 @@ class InferenceEngine:
                 for j, bid in enumerate(got):
                     self._table_host[req.slot, have + j] = bid
                 req.block_ids.extend(got)
+        if self._roof is not None:
+            # graftkern live-occupancy pricing: count the work this wave
+            # ACTUALLY does per descriptor (prefill segments + the
+            # decode leg) before prefill_done advances. The ledger
+            # consumes it when note_wave prices this boundary's
+            # ("ragged", C) key; static max_slots x C capacity pricing
+            # stays exported as the capacity_* fields.
+            q_toks = attn_qk = kv_read = 0
+            in_work = set()
+            for req, clen, final in work:
+                if req.finished:
+                    continue
+                in_work.add(req.slot)
+                start = req.prefill_done
+                q_toks += clen
+                attn_qk += clen * start + clen * (clen + 1) // 2
+                kv_read += start
+                if final:
+                    plen = len(req.tokens)
+                    q_toks += 1
+                    attn_qk += plen
+                    kv_read += plen
+            for slot, req in enumerate(self._slots):
+                if (req is None or slot in in_work or req.finished
+                        or req.prefilling or not self._active_host[slot]):
+                    continue
+                pos = min(
+                    len(req.tokens) + max(req.n_generated, 1) - 1,
+                    Smax - 1,
+                )
+                q_toks += 1
+                attn_qk += pos
+                kv_read += pos
+            self._roof.note_ragged_occupancy(q_toks, kv_read, attn_qk)
         # Post-prefill bookkeeping BEFORE the roster/growth pass: final
         # rows flip to decoding so this wave's decode leg covers them
         # (their table rows grow to the first-token position), exactly

@@ -67,6 +67,7 @@ class JAXServer(SeldonComponent):
         kv_pool_mb: int = 0,
         ragged: int = -1,
         ragged_chunk: int = 0,
+        ragged_kernel: str = "",
         spec: int = -1,
         spec_k: int = 0,
         spec_draft: str = "",
@@ -160,6 +161,15 @@ class JAXServer(SeldonComponent):
         self.ragged = bool(int(ragged))
         self.ragged_chunk = int(
             ragged_chunk or _os.environ.get("RAGGED_CHUNK", "0") or 0
+        )
+        # graftkern attention leg (models/ragged_attention.py +
+        # ops/ragged_paged_attention.py): masked (bit-exact baseline) /
+        # sparse (block-sparse jnp walker) / pallas (Mosaic kernel;
+        # interpret-mode on CPU). Also selects the spec verify leg.
+        # Empty = follow the env (default masked).
+        self.ragged_kernel = (
+            ragged_kernel or _os.environ.get("RAGGED_KERNEL", "")
+            or "masked"
         )
         if self.ragged:
             self.paged_kv = True
@@ -339,6 +349,8 @@ class JAXServer(SeldonComponent):
                 ekw["ragged"] = True
                 if self.ragged_chunk:
                     ekw["ragged_chunk"] = self.ragged_chunk
+            if self.ragged_kernel != "masked":
+                ekw["ragged_kernel"] = self.ragged_kernel
             draft = None
             if self.spec:
                 ekw["spec_decode"] = True

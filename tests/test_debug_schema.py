@@ -157,7 +157,8 @@ ROOF_STEP_KEYS = frozenset({
 ROOF_CONSERVATION_KEYS = frozenset({"checked", "breaches", "last_breach"})
 ROOF_VARIANT_KEYS = frozenset({
     "key", "family", "dispatches", "flops", "bytes", "device_ms",
-    "predicted_ms", "mfu", "mbu", "bound",
+    "predicted_ms", "capacity_flops", "capacity_bytes",
+    "capacity_predicted_ms", "mfu", "mbu", "bound",
 })
 ROOF_TOTALS_KEYS = frozenset({
     "dispatches", "flops", "bytes", "device_ms", "predicted_ms",

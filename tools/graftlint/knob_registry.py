@@ -60,6 +60,17 @@ KNOBS = {
                        "wave's flat token buffer is max_slots * "
                        "ragged_chunk. Power of two, multiple of "
                        "kv_block."),
+    "RAGGED_KERNEL": _k("engine-serving", "masked",
+                        "graftkern ragged attention leg: `masked` = "
+                        "bit-exact full-width baseline; `sparse` = "
+                        "block-sparse jnp walker touching only live KV "
+                        "blocks (online softmax, int8 dequant fused; "
+                        "the CPU perf leg); `pallas` = the Mosaic TPU "
+                        "kernel for the same walk (interpret-mode on "
+                        "CPU). Greedy outputs token-identical across "
+                        "legs; all legs share the ONE (ragged, C) "
+                        "compiled variant. Also selects the spec "
+                        "verify_wave leg."),
     "SPEC": _k("engine-serving", "0",
                "graftspec speculative decoding: a drafter proposes k "
                "tokens per live decode row and ONE wide ragged verify "
@@ -285,6 +296,11 @@ KNOBS = {
     "MB_DRAFT": _k("bench-tools", "(unset)", "Draft-model preset for the "
                    "`--spec k` microbench mode; adds the draft dispatch "
                    "to the wave cost."),
+    "MB_RAGGED_CHUNK": _k("bench-tools", "16", "Per-slot chunk capacity "
+                          "C for the `--ragged` kernel microbench wave."),
+    "MB_PALLAS": _k("bench-tools", "(unset)", "Non-empty adds the pallas "
+                    "leg (interpret-mode off-TPU — slow) to the "
+                    "`--ragged` kernel microbench."),
     "TUNE_ACT": _k("bench-tools", "int8", "Activation dtype for the 8b "
                    "tuning sweep."),
     "PROBE_PRESET": _k("bench-tools", "llama3-8b", "Slot-cliff probe preset "
