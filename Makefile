@@ -1,5 +1,5 @@
 # CI targets (reference: Jenkinsfile -> Makefile.ci + per-module Makefiles).
-.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit mesh-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
+.PHONY: proto test test-e2e tier1 lint sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit mesh-audit heal-audit bench bench-compare bench-orchestrator native native-tsan ci fuzz-alloc fuzz-chaos fuzz-graftsan
 
 # tier1 uses PIPESTATUS / pipefail (bash-isms).
 tier1: SHELL := /bin/bash
@@ -147,6 +147,17 @@ roof-audit:
 mesh-audit:
 	env JAX_PLATFORMS=cpu python -m tools.mesh_audit
 
+# Supervised fault-recovery gate (docs/operations.md "Surviving a wave
+# fault"): the tiny server under HEAL=1 + CHAOS=1 — a seeded storm of
+# dispatch faults, watchdog-length hangs and NaN injections with no
+# poison source — asserts a greedy + sampled wave stays byte-identical
+# to a clean reference engine, zero user-visible errors, /healthz ready
+# through the storm, zero sanitizer violations and live retraces, the
+# frozen /debug/health schema, the jaxserver_heal_* gauges, and the
+# flight-recorder heal records + trace_view heal lane.
+heal-audit:
+	env JAX_PLATFORMS=cpu python -m tools.heal_audit
+
 bench:
 	python bench.py
 
@@ -158,7 +169,7 @@ bench-compare:
 bench-orchestrator:
 	python bench_orchestrator.py
 
-ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit mesh-audit
+ci: lint test test-e2e sanitize trace-smoke compile-audit sched-audit pilot-audit spec-audit roof-audit mesh-audit heal-audit
 
 native-tsan:
 	$(MAKE) -C native tsan

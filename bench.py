@@ -132,6 +132,18 @@ SPEC_DRAFT = os.environ.get("BENCH_SPEC_DRAFT", "self")
 # bytes lower-is-better on real meshes). Recorded in detail.mesh.
 MESH_PHASE = os.environ.get("BENCH_MESH", "0") == "1"
 MESH_TP = int(os.environ.get("BENCH_MESH_TP", "2"))
+# Heal phase: the same greedy closed wave run twice at equal hardware —
+# clean, then under seeded CHAOS dispatch faults with graftheal
+# supervised recovery on — so the bench line prices what a fault storm
+# costs THROUGH the healer. Resurrection replays committed tokens with
+# deterministic per-position sampling keys, so every stream the faulted
+# leg completes must be bit-identical to the clean leg's (the assert IS
+# the benchmark — a healer that resumes on the wrong token must fail
+# here, not ship a number). tools/bench_compare.py gates
+# goodput_retained_frac higher-is-better and user_visible_errors
+# lower-exact. Recorded in detail.heal.
+HEAL_PHASE = os.environ.get("BENCH_HEAL", "0") == "1"
+HEAL_FAULT_P = float(os.environ.get("BENCH_HEAL_FAULT", "0.05"))
 PAGED_DENSE_SLOTS = int(os.environ.get("BENCH_PAGED_DENSE_SLOTS", "4"))
 PAGED_KV_BLOCK = int(os.environ.get("BENCH_PAGED_KV_BLOCK", "16"))
 BASELINE_REQ_S_PER_CHIP = 125.0  # 1000 req/s north star / 8 chips
@@ -1511,6 +1523,137 @@ def _measure_mesh(params, cfg) -> dict:
     }
 
 
+def _measure_heal(params, cfg) -> dict:
+    """BENCH_HEAL phase: the same greedy closed wave run twice at equal
+    hardware — clean (no faults), then under seeded CHAOS dispatch
+    faults with graftheal supervised recovery on. The healed leg's
+    completed streams are asserted bit-identical to the clean leg's
+    (replay-based resurrection with per-position sampling keys makes
+    that the contract, not a hope), then the phase prices the storm:
+    goodput_retained_frac — bit-identical completions over offered —
+    user_visible_errors — streams that ended in an error item; under
+    heal only quarantine and retry exhaustion may produce one — the
+    supervisor's recovery counters, and per-leg req/s."""
+    import numpy as np
+
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.servers.chaos import ChaosConfig
+    from seldon_tpu.servers.engine import EngineConfig, InferenceEngine
+
+    prompt_len = 32
+    new_toks = min(NEW_TOKENS, 16)
+    slots = 8
+    n_req = 3 * slots
+    rng = np.random.default_rng(37)
+    prompts = [
+        rng.integers(3, cfg.vocab_size, size=(prompt_len,)).tolist()
+        for _ in range(n_req)
+    ]
+
+    def leg(healed: bool, chaotic: bool = True):
+        ecfg = EngineConfig(
+            max_slots=slots,
+            # Headroom past prompt+decode: resurrection folds committed
+            # tokens into the prompt, so the bucket list must hold
+            # prompt_len + new_toks (next power of two) or a healed
+            # request can't re-admit.
+            max_seq_len=2 * prompt_len + 2 * new_toks,
+            prompt_buckets=(prompt_len, 2 * prompt_len),
+            max_admit=4,
+            decode_chunk=4,
+            heal=healed,
+            heal_max_retries=3,
+            chaos=(ChaosConfig(seed=13, dispatch_fail=HEAL_FAULT_P)
+                   if chaotic else None),
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+        engine.warmup()
+        engine.start()
+        t0 = time.perf_counter()
+        qs = [engine.submit(p, SamplingParams(
+                  temperature=0.0, top_k=0, top_p=1.0,
+                  max_new_tokens=new_toks, seed=i))
+              for i, p in enumerate(prompts)]
+        streams, errors = [], []
+        for q in qs:
+            toks, err = [], None
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    break
+                if "error" in item:
+                    err = item
+                    continue
+                toks.extend(item.get("tokens", []))
+            streams.append(toks)
+            errors.append(err)
+        dt = time.perf_counter() - t0
+        out = {
+            "req_per_s": round(n_req / dt, 3),
+            "makespan_s": round(dt, 3),
+            **_compile_counts(engine),
+            **_sched_counts(engine),
+        }
+        health = engine.debug_health()
+        chaos = engine.chaos_counts()
+        engine.stop()
+        return out, streams, errors, health, chaos
+
+    clean, want, clean_errs, _, _ = leg(healed=False, chaotic=False)
+    if any(clean_errs):
+        raise RuntimeError(f"clean heal leg errored: {clean_errs}")
+    # The _fail_all cliff: the SAME seeded storm with the supervisor
+    # off — every fault wipes the whole in-flight cohort, which is what
+    # the healed leg is priced against. Informational (the keys avoid
+    # every bench_compare direction table): cross-run wave composition
+    # shifts how many requests each fault catches, so gating the cliff
+    # would flake, and its only job is showing the gap.
+    cliff, cliff_got, cliff_errs, _, _ = leg(healed=False, chaotic=True)
+    cliff_ok = sum(
+        1 for i, (toks, err) in enumerate(zip(cliff_got, cliff_errs))
+        if err is None and toks == want[i]
+    )
+    healed, got, errs, health, chaos = leg(healed=True)
+
+    ok = 0
+    for i, (toks, err) in enumerate(zip(got, errs)):
+        if err is not None:
+            continue
+        if toks != want[i]:  # the whole contract: healing changes nothing
+            raise RuntimeError(
+                f"resurrected stream {i} diverged from the clean leg")
+        ok += 1
+    visible = sum(1 for e in errs if e is not None)
+    sanctioned = (health or {}).get("quarantined", 0) \
+        + (health or {}).get("retry_exhausted", 0)
+    if visible > sanctioned:
+        raise RuntimeError(
+            f"{visible} user-visible errors but only {sanctioned} "
+            "quarantined/exhausted — the healer leaked an innocent fault")
+    return {
+        "fault_p": HEAL_FAULT_P,
+        "n_req": n_req,
+        "clean": clean,
+        "healed": healed,
+        "unhealed": cliff,
+        "bit_identical": True,
+        "goodput_retained_frac": round(ok / n_req, 4),
+        "user_visible_errors": visible,
+        "unhealed_completed_frac": round(cliff_ok / n_req, 4),
+        "unhealed_failed_streams": sum(
+            1 for e in cliff_errs if e is not None),
+        "req_s_retained_frac": (
+            round(healed["req_per_s"] / clean["req_per_s"], 3)
+            if clean["req_per_s"] else None),
+        "dispatch_faults": (chaos or {}).get("dispatch_faults", 0),
+        "recoveries": (health or {}).get("recoveries", 0),
+        "resurrected": (health or {}).get("resurrected", 0),
+        "quarantined": (health or {}).get("quarantined", 0),
+        "retry_exhausted": (health or {}).get("retry_exhausted", 0),
+        "watchdog_trips": (health or {}).get("watchdog_trips", 0),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1611,6 +1754,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — recorded, not swallowed
             _log(f"mesh phase failed: {e!r}")
             detail["mesh_error"] = str(e)
+
+    if HEAL_PHASE:
+        emit(partial=True)
+        try:  # trailing phase: a failure degrades to an error note
+            detail["heal"] = _measure_heal(params, cfg)
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            _log(f"heal phase failed: {e!r}")
+            detail["heal_error"] = str(e)
 
     # Second-preset phase: the 8B headline run also records the bench-1b
     # deployment proxy (throughput + SLO search) in detail.bench_1b —

@@ -425,6 +425,10 @@ def build_rest_app(
         "debug_roof", "unit has no roof ledger",
         "roof ledger disabled (set ROOF_LEDGER=1)",
     ))
+    app.router.add_get("/debug/health", _debug_route(
+        "debug_health", "unit has no heal supervisor",
+        "heal supervisor disabled (set HEAL=1)",
+    ))
 
     # Every observability surface with its arming knob, so operators
     # stop probing /debug/* routes one 404 hint at a time. Kept in
@@ -436,6 +440,7 @@ def build_rest_app(
         ("/debug/sched", "debug_sched", "SCHED_LEDGER"),
         ("/debug/pilot", "debug_pilot", "PILOT"),
         ("/debug/roof", "debug_roof", "ROOF_LEDGER"),
+        ("/debug/health", "debug_health", "HEAL"),
     )
 
     async def handle_debug_index(request: web.Request) -> web.Response:
@@ -463,6 +468,10 @@ def build_rest_app(
     app.router.add_get("/health/live", handle_live)
     app.router.add_get("/ready", handle_ready)
     app.router.add_get("/health/ready", handle_ready)
+    # k8s-idiom readiness alias: same probe as /ready — a recovering
+    # engine stays ready (graftheal keeps it serving); only not-loaded
+    # / draining / a broken accelerator read 503.
+    app.router.add_get("/healthz", handle_ready)
     app.router.add_get("/ping", handle_live)
     app.router.add_get("/metadata", handle_metadata)
     app.router.add_get("/metrics", handle_metrics)

@@ -96,8 +96,10 @@ Concurrency contract (the compile-ledger discipline, applied again):
            "spec_drafted": int, "spec_accepted": int,
            "goodput": float,
            "queue_depth": int, "free_slots": int,
-           "roof_backlog_ms": float},  # graftroof queue cost (0 when
+           "roof_backlog_ms": float,   # graftroof queue cost (0 when
                                        # ROOF_LEDGER is off)
+           "heal_pressure": float},    # graftheal recovery pressure
+                                       # (0 when HEAL is off)
          "effect": null | {"goodput_delta": float,
                            "waste_frac_delta": float}},
         ...
@@ -166,9 +168,12 @@ _DELTA_KEYS = (
 # Instantaneous signals copied into the window as-is. roof_backlog_ms
 # is the graftroof cost model's predicted service time of the queue
 # (0.0 whenever ROOF_LEDGER is off) — the level a cost-model tier
-# router conditions on.
+# router conditions on. heal_pressure is the graftheal supervisor's
+# recovery-pressure level (0.0 healthy / 0.5 recovering / 1.0
+# degraded; 0.0 whenever HEAL is off) — a pilot conditioning on it can
+# back off admissions while replays drain.
 _LEVEL_KEYS = ("goodput", "queue_depth", "free_slots",
-               "roof_backlog_ms")
+               "roof_backlog_ms", "heal_pressure")
 
 
 def from_env() -> Optional["PilotController"]:

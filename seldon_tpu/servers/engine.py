@@ -33,7 +33,8 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Deque, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +47,7 @@ from seldon_tpu.models.config import ModelConfig
 from seldon_tpu.models.sampling import SamplingParams, sample_per_row
 from seldon_tpu.servers import compile_ledger, controller, cost_model
 from seldon_tpu.servers import flight_recorder, graftsan, hbm_ledger
-from seldon_tpu.servers import sched_ledger, shape_lattice
+from seldon_tpu.servers import sched_ledger, shape_lattice, supervisor
 from seldon_tpu.servers.chaos import ChaosConfig, ChaosMonkey
 
 logger = logging.getLogger(__name__)
@@ -63,6 +64,7 @@ KIND_HTTP_STATUS = {
     "preempted": 503,
     "deadline": 504,  # client-set TTL lapsed — not a server fault
     "cancelled": 499,  # client closed the connection (nginx convention)
+    "poison": 500,  # quarantined: deterministically faults the wave
 }
 
 
@@ -204,6 +206,19 @@ class EngineConfig:
     default_deadline_ms: int = 0
     max_queue: int = 0
     chaos: Optional[ChaosConfig] = None
+    # graftheal supervised fault recovery (servers/supervisor.py; False
+    # also consults the HEAL=1 env gate via supervisor.build, so
+    # recovery can be enabled without config plumbing). Off keeps the
+    # _fail_all failure path byte-identical to the pre-heal engine:
+    # a faulted wave fails every live request. On, innocent in-flight
+    # requests are resurrected by replaying their committed tokens
+    # through the normal admission path (bit-identical continuation via
+    # per-position sampling keys), bounded by a per-request replay
+    # budget; heal_watchdog_ms > 0 additionally bounds every boundary
+    # device fetch so a hung wave faults instead of wedging.
+    heal: bool = False
+    heal_max_retries: int = 4
+    heal_watchdog_ms: int = 0
 
     def __post_init__(self):
         def pow2(n: int) -> bool:
@@ -347,6 +362,17 @@ class EngineConfig:
                 f"max_queue ({self.max_queue}) must be >= 0 (0 leaves the "
                 f"admission queue unbounded)"
             )
+        if self.heal_max_retries < 1:
+            raise ValueError(
+                f"heal_max_retries ({self.heal_max_retries}) must be >= 1 "
+                f"— a request must be allowed at least one resurrection "
+                f"or heal can never recover anything"
+            )
+        if self.heal_watchdog_ms < 0:
+            raise ValueError(
+                f"heal_watchdog_ms ({self.heal_watchdog_ms}) must be >= 0 "
+                f"(0 disables the boundary-fetch watchdog)"
+            )
 
 
 @dataclasses.dataclass
@@ -380,10 +406,17 @@ class _Request:
     # at — owned and zero-copy-shared alike each carry one allocator ref
     # taken at admission/growth, so release is a uniform unref sweep.
     block_ids: List[int] = dataclasses.field(default_factory=list)
-    # Speculative-decoding state: every token emitted so far, in order —
-    # the drafter's history source (prompt + gen_hist). Only populated
-    # when spec_decode is on; the spec-off engine never appends.
+    # Speculative-decoding / graftheal state: every token emitted so
+    # far, in order — the drafter's history source and the heal
+    # supervisor's replay source. Only populated when spec_decode or
+    # heal is on; otherwise the engine never appends.
     gen_hist: List[int] = dataclasses.field(default_factory=list)
+    # graftheal: how many gen_hist tokens have been folded into
+    # `tokens` by resurrection replays. The drafter's history is
+    # tokens + gen_hist[replayed:]; n_generated counts tokens since the
+    # CURRENT admission, so replayed + n_generated is the client-
+    # delivered total.
+    replayed: int = 0
     # Observability: when the scheduler first dispatched work for this
     # request (queue-wait = first_dispatch_at - submitted_at) and when its
     # latest token burst was emitted (drives the ITL histogram).
@@ -402,6 +435,29 @@ class _Request:
     # carries open span objects.
     trace: Any = None
     outcome: str = ""
+
+
+class _PendingWave(NamedTuple):
+    """One dispatched-but-unfetched boundary: the admission groups, the
+    decode-chunk device handles, the slot->request roster snapshot, the
+    DISPATCH_TIMING token, and the device-state epoch the wave was
+    dispatched against. Named so the failure paths (_fail_all /
+    _shutdown_sweep) read fields by name — the next timing-tuple growth
+    can't silently misalign failure accounting. Still iterable, so
+    `_process_boundary(*pending)` is unchanged.
+
+    `epoch` exists for graftheal: a wave dispatched before a fault's
+    device-state rebuild must be DISCARDED if it surfaces afterwards —
+    its roster references pre-rebuild slots, and delivering its tokens
+    to a resurrected (unfinished) request would double them. Pre-heal
+    this race was benign because every wrecked request was terminally
+    failed; resurrection makes staleness load-bearing."""
+
+    admits: List[Tuple[List["_Request"], Any, Any, Any]]
+    chunk_handles: Any
+    roster: Optional[List[Optional["_Request"]]]
+    timing: Any
+    epoch: int = 0
 
 
 class EngineStats:
@@ -768,6 +824,22 @@ class InferenceEngine:
         self._fetch_q: "queue.Queue" = queue.Queue(maxsize=4)
         self._fetcher: Optional[threading.Thread] = None
         self._dispatch_wreck = None  # partial boundary for error paths  # graftlint: guarded-by(_book)
+        # Bumped by every device-state rebuild; waves dispatched against
+        # an older epoch are discarded at fetch time (see _PendingWave).
+        self._wave_epoch = 0  # graftlint: guarded-by(_book)
+        # Every dispatched-but-unretired wave, registered under _book at
+        # dispatch time and retired under _book by the fetcher (after
+        # processing OR after an epoch-stale discard). Requests
+        # optimistically recycled out of _slots live ONLY in their
+        # wave's roster, and a wave is invisible to _fetch_q scavenging
+        # twice per boundary: between dispatch and the (bounded,
+        # lock-free) put, and between the fetcher's get and its epoch
+        # check. This registry is therefore the authoritative gather
+        # source for wave-fault recovery — _gather_wrecked walks it
+        # instead of draining the queue, which raced the scheduler's
+        # puts and stranded whole waves (epoch-discarded unread, their
+        # requests in no book).
+        self._inflight_waves: List[_PendingWave] = []  # graftlint: guarded-by(_book)
 
         # Host-side bookkeeping.
         self._slots: List[Optional[_Request]] = [None] * B  # graftlint: guarded-by(_book)
@@ -792,6 +864,16 @@ class InferenceEngine:
         if chaos_cfg is not None and chaos_cfg.any_enabled():
             self._chaos = ChaosMonkey(chaos_cfg)
             logger.warning("chaos fault injection enabled: %s", chaos_cfg)
+        # graftheal supervised recovery (opt-in; supervisor.build also
+        # consults the HEAL=1 env gate). None keeps the _fail_all
+        # failure path — and every hot path — byte-identical.
+        self._heal: Optional[supervisor.HealSupervisor] = \
+            supervisor.build(self.ecfg)
+        if self._heal is not None:
+            logger.warning(
+                "graftheal supervised recovery enabled: %s",
+                self._heal.describe(),
+            )
 
         # Largest power of two <= min(max_admit, max_slots).
         ma = max(1, min(self.ecfg.max_admit, B))
@@ -2056,6 +2138,7 @@ class InferenceEngine:
                     and not self._waiting
                     and not self._prefilling
                     and self._pending.empty()
+                    and (self._heal is None or self._heal.pen_empty())
                 )
             if idle and self._fetch_q.empty():
                 return True
@@ -2085,6 +2168,10 @@ class InferenceEngine:
             with self._rid_lock:
                 if self._requests:
                     leaks["registry"] = sorted(self._requests)
+            if self._heal is not None and not self._heal.pen_empty():
+                leaks["heal_pen"] = sorted(
+                    r.rid for r in self._heal.pen_scan()
+                )
             if self._paged:
                 if self._paged_prefix is not None:
                     self._paged_prefix.flush()
@@ -2104,7 +2191,13 @@ class InferenceEngine:
         return self._chaos.snapshot() if self._chaos is not None else {
             "dispatch_faults": 0, "alloc_faults": 0,
             "slow_boundaries": 0, "disconnects": 0,
+            "nan_injects": 0, "hangs": 0, "sticky_faults": 0,
         }
+
+    def debug_health(self) -> Optional[Dict[str, Any]]:
+        """graftheal supervisor snapshot for the /debug/health endpoint
+        (None when HEAL is off — the raw failure path is in effect)."""
+        return self._heal.snapshot() if self._heal is not None else None
 
     def slots_busy(self) -> int:
         """Occupied-slot count, read under the bookkeeping lock. The one
@@ -2194,11 +2287,10 @@ class InferenceEngine:
                     break
                 if item is None:
                     continue
-                admits, _, roster, _ = item
-                for group, _, _, _ in admits:
+                for group, _, _, _ in item.admits:
                     for req in group:
                         live[req.rid] = req
-                for req in roster or []:
+                for req in item.roster or []:
                     if req is not None:
                         live[req.rid] = req
             for req in self._slots:
@@ -2206,6 +2298,10 @@ class InferenceEngine:
                     live[req.rid] = req
             for req in self._prefilling:
                 live[req.rid] = req
+            if self._heal is not None:
+                # Penned resurrectees are in neither _slots nor _waiting.
+                for req in self._heal.pen_take(0.0, flush=True):
+                    live.setdefault(req.rid, req)
             self._drain_pending()
             while self._waiting:
                 req = self._waiting.popleft()
@@ -2633,6 +2729,9 @@ class InferenceEngine:
             "spec_drafted": sled["spec"]["drafted_tokens"],
             "spec_accepted": sled["spec"]["accepted_tokens"],
             "roof_backlog_ms": self._roof_backlog_ms(),
+            "heal_pressure": (
+                self._heal.pressure() if self._heal is not None else 0.0
+            ),
         }
 
     def _roof_backlog_ms(self) -> float:  # graftlint: holds(_book)
@@ -2754,12 +2853,14 @@ class InferenceEngine:
                     "admission failed for requests %s",
                     [r.rid for r in group],
                 )
-                for req in group:
-                    slot = req.slot
-                    if slot >= 0 and self._slots[slot] is not req \
-                            and slot not in self._free:
-                        self._free.append(slot)  # popped but never registered
-                    self._fail_req(req, str(e), kind="internal")
+                if not self._heal_requeue_group(group, str(e)):
+                    for req in group:
+                        slot = req.slot
+                        if slot >= 0 and self._slots[slot] is not req \
+                                and slot not in self._free:
+                            # Popped but never registered.
+                            self._free.append(slot)
+                        self._fail_req(req, str(e), kind="internal")
         # Bucket-mismatch wait attribution: the engine filled up and the
         # head-of-line request buckets differently from the last group
         # admitted — it waits behind the lattice shape, not raw capacity.
@@ -2785,7 +2886,7 @@ class InferenceEngine:
         carries only suffixes (so the jit variant is keyed on
         (Pb, Sb, G) — one compile per prefix bucket, mirroring the
         prompt-bucket discipline)."""
-        self._chaos_dispatch("admit")
+        self._chaos_dispatch("admit", [r.rid for r in group])
         G = len(group)
         Gp = 1
         while Gp < G:
@@ -3299,7 +3400,7 @@ class InferenceEngine:
         dispatch the fused chunk kernel. G pads to a power of two by
         replicating the last row (identical slot + data — duplicate
         scatters are well-defined), mirroring _dispatch_admit_group."""
-        self._chaos_dispatch("prefill-chunk")
+        self._chaos_dispatch("prefill-chunk", [r[0].rid for r in rows])
         group = [r[0] for r in rows]
         Sc, W = rows[0][1], rows[0][2]
         G = len(rows)
@@ -3505,8 +3606,11 @@ class InferenceEngine:
                         "chunk dispatch failed for requests %s",
                         [r[0].rid for r in rows],
                     )
-                    for req, *_ in rows:
-                        self._fail_req(req, str(e), kind="internal")
+                    if not self._heal_requeue_group(
+                        [r[0] for r in rows], str(e)
+                    ):
+                        for req, *_ in rows:
+                            self._fail_req(req, str(e), kind="internal")
                 i = j
         if n_chunks:
             with self.stats.lock:
@@ -3609,7 +3713,7 @@ class InferenceEngine:
         work = self._collect_ragged_work(budget)
         if not work and not self._active_host.any():
             return None
-        self._chaos_dispatch("ragged")
+        self._chaos_dispatch("ragged", self._live_wave_rids())
         Smax = self.ecfg.max_seq_len
         toks = np.full((B, C), self.cfg.pad_token_id, np.int32)
         plens = np.ones((B,), np.int32)
@@ -3718,7 +3822,7 @@ class InferenceEngine:
                 self._insert_paged_prompt(req, upto=req.prefill_done)
         self._record_first_dispatch(group)
         roster = self._roster()
-        self._dispatch_wreck = ([], None, roster, None)
+        self._dispatch_wreck = _PendingWave([], None, roster, None)
         self._grow_decode_blocks(1)
         if self._observe:
             t0 = time.perf_counter()
@@ -3748,7 +3852,7 @@ class InferenceEngine:
                 int(toks.nbytes) + B * self.cfg.vocab_size * 4
             )
         admits = [(group, finals_l, first, first_done)] if group else []
-        self._dispatch_wreck = (admits, None, roster, None)
+        self._dispatch_wreck = _PendingWave(admits, None, roster, None)
         with self.stats.lock:
             self.stats.decode_dispatches += 1
             self.stats.decode_steps += 1
@@ -3797,7 +3901,10 @@ class InferenceEngine:
             self._recorder.record("boundary", -1, detail)
         timing = self._make_timing() if self._timing_on else None
         self._dispatch_wreck = None
-        return (admits, (toks_d, valid_d, active_d), roster, timing)
+        return _PendingWave(
+            admits, (toks_d, valid_d, active_d), roster, timing,
+            self._wave_epoch,
+        )
 
     # --- speculative decoding (graftspec) ----------------------------------
 
@@ -3834,8 +3941,10 @@ class InferenceEngine:
         if not rows:
             return drafts, wave, 0
         if self._drafter.uses_model:
+            # gen_hist[replayed:] — resurrection folds earlier tokens
+            # into req.tokens, so the un-replayed tail IS the history.
             hists = [
-                (slot, list(req.tokens) + req.gen_hist)
+                (slot, list(req.tokens) + req.gen_hist[req.replayed:])
                 for slot, req in rows
             ]
             if self._observe:
@@ -3849,7 +3958,7 @@ class InferenceEngine:
         else:
             for slot, req in rows:
                 drafts[slot] = self._drafter.draft(
-                    req.tokens, req.gen_hist, k
+                    req.tokens, req.gen_hist[req.replayed:], k
                 )
         return drafts, wave, len(rows)
 
@@ -3867,17 +3976,17 @@ class InferenceEngine:
             self._dispatch_prefill_chunks() if self._chunked
             else self._dispatch_admits()
         )
-        self._dispatch_wreck = (admits, None, None, None)
+        self._dispatch_wreck = _PendingWave(admits, None, None, None)
         chunk_handles = None
         roster = None
         if admits or self._active_host.any():
             roster = self._roster()
-            self._dispatch_wreck = (admits, None, roster, None)
+            self._dispatch_wreck = _PendingWave(admits, None, roster, None)
             if self._active_host.any():
                 k = self._pick_spec_k()
                 drafts, wave, n_wave = self._collect_drafts(k)
                 self._spec_wave = (k, wave, n_wave)
-                self._chaos_dispatch("decode")
+                self._chaos_dispatch("decode", self._live_wave_rids())
                 # k + 1 worst-case new positions per row; expected is
                 # EXACT under spec (resynced to n_generated every
                 # boundary), so growth covers pos0 .. pos0 + k and
@@ -3907,7 +4016,8 @@ class InferenceEngine:
         if admits or chunk_handles is not None:
             timing = self._make_timing() if self._timing_on else None
             self._dispatch_wreck = None
-            return (admits, chunk_handles, roster, timing)
+            return _PendingWave(admits, chunk_handles, roster, timing,
+                                self._wave_epoch)
         self._dispatch_wreck = None
         return None
 
@@ -4028,7 +4138,7 @@ class InferenceEngine:
                         self._dispatch_wreck, None
                     )
                     self._spec_wave = None
-                    self._fail_all(str(e), [wreck])
+                    self._fail_or_heal(str(e), [wreck])
 
     # --- boundary processing -----------------------------------------------
 
@@ -4042,6 +4152,7 @@ class InferenceEngine:
         ):
             now = time.perf_counter()
             ttft_total = 0.0
+            n_first = 0
             # finals=None: one-shot admission, every row armed. A chunked
             # group's non-final rows deposited KV only — no token exists
             # for them yet, so they are skipped wholesale here.
@@ -4059,14 +4170,22 @@ class InferenceEngine:
                 # batch IS the group); bucketed groups are group-indexed.
                 idx = slot if self._ragged else i
                 first_tok = int(first_h[idx])
-                req.first_token_at = now
                 req.last_burst_at = now
-                ttft_ms = 1000.0 * (now - req.submitted_at)
-                ttft_total += ttft_ms
                 req.n_generated = 1
-                if self._spec:
+                if self._spec or self._heal is not None:
                     req.gen_hist.append(first_tok)
-                req.out.put({"tokens": [first_tok], "ttft_ms": ttft_ms})
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                    ttft_ms = 1000.0 * (now - req.submitted_at)
+                    ttft_total += ttft_ms
+                    n_first += 1
+                    req.out.put({"tokens": [first_tok], "ttft_ms": ttft_ms})
+                else:
+                    # Resurrected re-admission: the client saw its first
+                    # token before the fault — no second TTFT sample.
+                    req.out.put({"tokens": [first_tok]})
+                if self._heal is not None:
+                    self._heal.note_progress(req.rid)
                 if bool(done_h[idx]):
                     self._complete(req)
                 elif self._slots[slot] is req:
@@ -4075,7 +4194,7 @@ class InferenceEngine:
                     self._active_host[slot] = True
             with self.stats.lock:
                 self.stats.ttft_sum += ttft_total / 1000.0
-                self.stats.ttft_count += n_armed
+                self.stats.ttft_count += n_first
                 self.stats.tokens_out += n_armed
 
     def _process_chunk(self, toks_h, valid_h, active_h, roster) -> None:  # graftlint: holds(_book)
@@ -4096,11 +4215,13 @@ class InferenceEngine:
             n = int(n_valid[slot])
             if n:
                 burst = toks_h[:n, slot].tolist()
-                if self._spec:
+                if self._spec or self._heal is not None:
                     req.gen_hist.extend(burst)
                 req.out.put({"tokens": burst})
                 req.n_generated += n
                 total += n
+                if self._heal is not None:
+                    self._heal.note_progress(req.rid)
                 if req.last_burst_at is not None:
                     # Burst-gap ITL: one sample per boundary burst — the
                     # client-visible stall a prefill interloper causes.
@@ -4114,11 +4235,22 @@ class InferenceEngine:
                 for g in gaps_ms:
                     self.stats.record_itl_locked(g)
 
-    def _chaos_dispatch(self, site: str) -> None:
+    def _live_wave_rids(self) -> List[int]:  # graftlint: holds(_book)
+        """The rids riding a whole-batch (decode/ragged/verify) wave —
+        the sticky chaos fault's membership test."""
+        return [
+            r.rid for r in self._slots
+            if r is not None and not r.finished
+        ]
+
+    def _chaos_dispatch(self, site: str,
+                        rids: Sequence[int] = ()) -> None:
         """Dispatch-failure injection point, active ONLY on the scheduler
         thread — warmup and direct test calls share the dispatch helpers
         and must neither fault nor consume draws (the seeded fault
-        sequence is defined over scheduler-loop dispatches alone)."""
+        sequence is defined over scheduler-loop dispatches alone).
+        `rids` is the dispatched wave's membership, for the sticky
+        (per-request deterministic) fault."""
         if self._san is not None and (
             threading.current_thread() is self._thread
         ):
@@ -4127,7 +4259,7 @@ class InferenceEngine:
             threading.current_thread() is self._thread
         ):
             try:
-                self._chaos.on_dispatch(site)
+                self._chaos.on_dispatch(site, rids)
             except Exception:
                 # An injected dispatch fault is about to unwind the
                 # scheduler iteration — pin it to the timeline first.
@@ -4138,9 +4270,9 @@ class InferenceEngine:
     def _fail_req(self, req: _Request, msg: str,  # graftlint: holds(_book)
                   kind: str = "internal", retriable: bool = False) -> None:
         """Fail one request with a typed error item (kind in {internal,
-        capacity, preempted, cancelled, deadline, draining, shutdown}),
-        then finalize it — slot/blocks/trie refs freed, None sentinel
-        queued. Idempotent like _complete."""
+        capacity, preempted, cancelled, deadline, draining, shutdown,
+        poison}), then finalize it — slot/blocks/trie refs freed, None
+        sentinel queued. Idempotent like _complete."""
         if req.finished:
             return
         req.outcome = kind
@@ -4155,6 +4287,8 @@ class InferenceEngine:
         if req.finished:
             return
         req.finished = True
+        if self._heal is not None:
+            self._heal.note_done(req.rid)
         now = time.perf_counter()
         margin_ms = (
             1000.0 * (req.deadline - now) if req.deadline is not None
@@ -4246,38 +4380,78 @@ class InferenceEngine:
                     attributes={"tokens": req.n_generated},
                 )
 
+    def _wave_retire(self, item) -> None:  # graftlint: holds(_book)
+        """Remove one wave from the in-flight registry by identity
+        (waves hold unhashable device arrays). No-op for waves never
+        registered (sync-mode boundaries, partial wrecks)."""
+        for i, wave in enumerate(self._inflight_waves):
+            if wave is item:
+                del self._inflight_waves[i]
+                return
+
+    def _gather_wrecked(self, pendings=()) -> Dict[int, _Request]:  # graftlint: holds(_book)
+        """Every request a wrecked dispatch may have owned: the live
+        slot table plus the in-flight pending waves, whose admit groups
+        and rosters hold requests already optimistically recycled out of
+        `_slots`. Pendings are normalized through _PendingWave so a
+        future timing-tuple growth can't silently misalign failure
+        accounting. The in-flight wave registry is folded in because it
+        is the only complete census of dispatched-but-unretired waves:
+        a wave sitting in `_fetch_q`, held by the fetcher pre-epoch-
+        check, or built but not yet put by the scheduler is invisible
+        to everything else, and the epoch guard will discard it unread
+        — a request recycled out of `_slots` into such a wave exists
+        nowhere else."""
+        live: Dict[int, _Request] = {}
+        for req in self._slots:
+            if req is not None:
+                live[req.rid] = req
+        for pending in (*pendings, *self._inflight_waves):
+            if pending is None:
+                continue
+            wave = _PendingWave(*pending)
+            for group, _, _, _ in wave.admits:
+                for req in group:
+                    live[req.rid] = req
+            for req in wave.roster or []:
+                if req is not None:
+                    live[req.rid] = req
+        return live
+
     def _fail_all(self, err: str, pendings=()) -> None:  # graftlint: holds(_book)
         """Fail every live request and reset device + slot state — called
-        when a dispatched computation errored (donated buffers are gone).
-        `pendings`: in-flight (admits, handles, roster, timing) tuples —
-        requests optimistically recycled out of `_slots` live only
-        there."""
+        when a dispatched computation errored (donated buffers are gone)
+        and the heal supervisor is off (or the engine is stopping).
+        `pendings`: in-flight _PendingWave tuples — requests
+        optimistically recycled out of `_slots` live only there."""
         if self._san is not None:
             self._san.assert_holds("_book")
         if self._spec:
             self._spec_wave = None  # descriptor of a wave now wrecked
         if self._recorder is not None:
             self._recorder.record("fail-all", -1, {"error": err[:200]})
-        live: Dict[int, _Request] = {}
-        for req in self._slots:
-            if req is not None:
-                live[req.rid] = req
-        for pending in pendings:
-            if pending is None:
-                continue
-            admits, _, roster, _ = pending
-            for group, _, _, _ in admits:
-                for req in group:
-                    live[req.rid] = req
-            for req in roster or []:
-                if req is not None:
-                    live[req.rid] = req
-        for req in live.values():
+        for req in self._gather_wrecked(pendings).values():
             if not req.finished:
                 # Engine-wreck failures are retriable: the device state is
                 # rebuilt fresh right below and the request did nothing
                 # wrong.
                 self._fail_req(req, err, kind="internal", retriable=True)
+        self._rebuild_device_state()
+
+    def _rebuild_device_state(self) -> None:  # graftlint: holds(_book)
+        """Reset device + slot state after a wrecked dispatch: the jit
+        functions donated their argument buffers, so whatever the device
+        held is gone — fresh slots, fresh paged pool bookkeeping, fresh
+        carried state. Every live request must already be failed
+        (_fail_all) or detached for resurrection (_prepare_resurrect)
+        before this runs."""
+        # Invalidate every dispatched-but-unretired boundary: rosters in
+        # flight reference pre-rebuild slots, and the async fetcher may
+        # surface one AFTER this rebuild. _fetch_loop discards waves
+        # whose epoch is stale instead of delivering their tokens twice
+        # — safe because the caller gathered every registered wave's
+        # requests (_gather_wrecked) before bumping the epoch here.
+        self._wave_epoch += 1
         B = self.ecfg.max_slots
         self._slots = [None] * B
         self._free = list(range(B))
@@ -4311,21 +4485,317 @@ class InferenceEngine:
                 graftsan.rewrap_pool(self, self._san)
         self._state = self._fresh_state()
 
+    # --- graftheal: supervised fault recovery --------------------------------
+
+    def _fail_or_heal(self, err: str, pendings=()) -> None:  # graftlint: holds(_book)
+        """Route a wrecked wave: supervised recovery when the heal
+        supervisor is armed and the engine is staying up, else the
+        kill-everyone _fail_all sweep — the raw failure path, byte-
+        identical to the pre-heal engine whenever HEAL is off."""
+        if (self._heal is None or self._stop.is_set()
+                or self._draining.is_set()):
+            self._fail_all(err, pendings)
+            return
+        logger.warning("graftheal: wave faulted (%s); recovering", err)
+        self._heal_recover(err, pendings)
+
+    def _heal_recover(self, err: str, pendings=()) -> None:  # graftlint: holds(_book)
+        """Supervised wave-fault recovery (the graftheal tentpole).
+        Instead of failing every innocent in-flight request, classify
+        the wrecked cohort through the supervisor — resurrect / pen
+        (bisection hold or retry backoff) / poison (deterministically
+        faults its wave; fails alone, non-retriable) / exhausted
+        (resurrection budget spent) — fail only the convicted, rewrite
+        the innocents for replay, then rebuild device state and re-queue
+        them at the FRONT of the admission queue in ascending-rid order
+        so replays stay ahead of fresh traffic. Deterministic
+        per-position sampling keys (fold_in(key(seed), abs_pos)) make
+        each replayed continuation bit-identical to its unfaulted run,
+        greedy and sampled alike."""
+        heal = self._heal
+        if self._san is not None:
+            self._san.assert_holds("_book")
+        if self._spec:
+            self._spec_wave = None  # descriptor of a wave now wrecked
+        now = time.perf_counter()
+        live = self._gather_wrecked(pendings)
+        # A stale wave still in the in-flight registry at a SECOND
+        # fault references requests an earlier
+        # recovery already resurrected into _waiting or penned. Those
+        # are safely parked, not wrecked: re-convicting them would
+        # charge a fault they didn't take, and re-resurrecting would
+        # duplicate them in the admission queue.
+        parked = {r.rid for r in self._waiting}
+        parked.update(r.rid for r in heal.pen_scan())
+        verdicts = heal.plan_recovery(
+            [rid for rid, r in live.items()
+             if not r.finished and rid not in parked],
+            now,
+        )
+        if self._recorder is not None:
+            counts: Dict[str, int] = {}
+            for v in verdicts.values():
+                counts[v] = counts.get(v, 0) + 1
+            self._recorder.record(
+                "heal", -1,
+                {"error": err[:200], "state": heal.state,
+                 "mode": heal.mode, **counts},
+            )
+        # Terminal verdicts and replay rewrites run BEFORE the rebuild:
+        # _fail_req unrefs blocks/trie pins into the old pool, which the
+        # rebuild then discards wholesale (same ordering as _fail_all).
+        queue_front: List[_Request] = []
+        pen: List[_Request] = []
+        for rid in sorted(verdicts):
+            req = live[rid]
+            if req.finished:
+                continue
+            v = verdicts[rid]
+            if v == "poison":
+                self._fail_req(
+                    req,
+                    f"quarantined: request deterministically faults its "
+                    f"wave ({err[:160]})",
+                    kind="poison", retriable=False,
+                )
+            elif v == "exhausted":
+                self._fail_req(
+                    req,
+                    f"resurrection budget exhausted "
+                    f"(heal_max_retries={heal.max_retries}): {err[:160]}",
+                    kind="internal", retriable=False,
+                )
+            elif self._prepare_resurrect(req):
+                (pen if v == "pen" else queue_front).append(req)
+        self._rebuild_device_state()
+        for req in reversed(queue_front):
+            self._waiting.appendleft(req)
+            heal.note_resurrected()
+        for req in pen:
+            heal.pen_put(req, now)
+
+    def _prepare_resurrect(self, req: _Request) -> bool:  # graftlint: holds(_book)
+        """Detach a wrecked-but-innocent request from the dead device
+        state and rewrite it for replay: committed tokens fold into the
+        prompt, the token budget shrinks by what the client already
+        holds, and the request re-enters the normal prefill/chunked
+        admission path as if freshly submitted — landing in an existing
+        prefill bucket, so resurrection compiles nothing. Returns False
+        when the request reached a terminal state here instead (fully
+        delivered, or the folded prompt can no longer be admitted)."""
+        fold = req.gen_hist[req.replayed:]
+        if fold:
+            req.tokens = list(req.tokens) + fold
+            req.replayed += len(fold)
+            remaining = req.params.max_new_tokens - len(fold)
+            if remaining <= 0:
+                # The client already holds every token the budget buys.
+                self._complete(req)
+                return False
+            req.params = dataclasses.replace(
+                req.params, max_new_tokens=remaining
+            )
+        if len(req.tokens) > max(self._buckets):
+            self._fail_req(
+                req,
+                f"resurrection impossible: folded prompt "
+                f"{len(req.tokens)} exceeds max bucket "
+                f"{max(self._buckets)}",
+                kind="internal", retriable=True,
+            )
+            return False
+        if self._paged:
+            need = -(-len(req.tokens) // self._kv_block)
+            if need > self._num_blocks - 1:
+                self._fail_req(
+                    req,
+                    f"resurrection impossible: folded prompt needs "
+                    f"{need} kv blocks but the pool holds "
+                    f"{self._num_blocks - 1}",
+                    kind="internal", retriable=True,
+                )
+                return False
+        # Detach from the wrecked device state. Paged block refs and
+        # trie handles just drop — the pool is rebuilt wholesale right
+        # after — but a DENSE prefix pin must be released: its trie
+        # survives the rebuild, and admission re-looks the prompt up.
+        if req.prefix_handle is not None and self._prefix is not None:
+            self._prefix.release(req.prefix_handle)
+        req.prefix_handle = None
+        req.prefix_len = None
+        req.block_ids = []
+        req.slot = -1
+        req.expected = 0
+        req.n_generated = 0
+        req.prefilling = False
+        req.prefill_done = 0
+        return True
+
+    def _heal_tick(self) -> None:  # graftlint: holds(_book)
+        """Boundary-time heal bookkeeping (scheduler thread, under
+        _book): reap cancelled/expired requests parked in the pen —
+        they sit in neither _slots nor _waiting, so the regular reap
+        cannot see them — then release due pen entries back into the
+        admission queue. Draining/stopping flushes the pen wholesale so
+        shutdown never strands a parked request."""
+        heal = self._heal
+        now = time.perf_counter()
+        for req in heal.pen_scan():
+            if req.finished:
+                continue
+            if req.cancelled:
+                with self.stats.lock:
+                    self.stats.cancelled_total += 1
+                self._fail_req(
+                    req, f"cancelled after {req.replayed} tokens",
+                    kind="cancelled",
+                )
+                heal.pen_drop(req.rid)
+            elif req.deadline is not None and now >= req.deadline:
+                with self.stats.lock:
+                    self.stats.deadline_expired_total += 1
+                self._fail_req(
+                    req, f"deadline exceeded after {req.replayed} tokens",
+                    kind="deadline",
+                )
+                heal.pen_drop(req.rid)
+        flush = self._draining.is_set() or self._stop.is_set()
+        for req in heal.pen_take(now, flush=flush):
+            self._waiting.appendleft(req)
+            heal.note_resurrected()
+
+    def _heal_requeue_group(self, reqs: List[_Request],  # graftlint: holds(_book)
+                            err: str) -> bool:
+        """Admission-group fault path with the supervisor armed. Unlike
+        a wrecked wave, a failed admission group never donated the
+        carried state away, so there is no rebuild: release the group's
+        slots/blocks/pins back into the LIVE pool and route each
+        request through the same supervisor verdicts as any wrecked
+        cohort. Returns False (caller falls back to the raw per-group
+        _fail_req sweep) when healing is off or the engine is going
+        down."""
+        if (self._heal is None or self._stop.is_set()
+                or self._draining.is_set()):
+            return False
+        heal = self._heal
+        now = time.perf_counter()
+        by_rid = {r.rid: r for r in reqs}
+        verdicts = heal.plan_recovery(
+            [r.rid for r in reqs if not r.finished], now
+        )
+        if self._recorder is not None:
+            counts: Dict[str, int] = {}
+            for v in verdicts.values():
+                counts[v] = counts.get(v, 0) + 1
+            self._recorder.record(
+                "heal", -1,
+                {"error": err[:200], "state": heal.state,
+                 "mode": heal.mode, "site": "admit", **counts},
+            )
+        queue_front: List[_Request] = []
+        for rid in sorted(verdicts):
+            req = by_rid[rid]
+            if req.finished:
+                continue
+            slot = req.slot
+            if slot >= 0:
+                if self._slots[slot] is req:
+                    self._slots[slot] = None
+                    self._active_host[slot] = False
+                    self._free.append(slot)
+                elif slot not in self._free:
+                    self._free.append(slot)  # popped, never registered
+            try:
+                self._prefilling.remove(req)
+            except ValueError:
+                pass
+            if self._paged:
+                self._release_blocks(req)
+            if req.prefix_handle is not None:
+                index = self._prefix if self._prefix is not None \
+                    else self._paged_prefix
+                if index is not None:
+                    index.release(req.prefix_handle)
+                req.prefix_handle = None
+            v = verdicts[rid]
+            if v == "poison":
+                self._fail_req(
+                    req,
+                    f"quarantined: request deterministically faults its "
+                    f"wave ({err[:160]})",
+                    kind="poison", retriable=False,
+                )
+            elif v == "exhausted":
+                self._fail_req(
+                    req,
+                    f"resurrection budget exhausted "
+                    f"(heal_max_retries={heal.max_retries}): {err[:160]}",
+                    kind="internal", retriable=False,
+                )
+            elif self._prepare_resurrect(req):
+                if v == "pen":
+                    heal.pen_put(req, now)
+                else:
+                    queue_front.append(req)
+        for req in reversed(queue_front):
+            self._waiting.appendleft(req)
+            heal.note_resurrected()
+        return True
+
+    def _fetch_boundary(self, admits, chunk_handles):
+        """One boundary's device->host fetch wrapped in the graftheal
+        guards: the chaos hang runs INSIDE the watchdog bound (an
+        injected hang is observed exactly like a wedged transfer), the
+        watchdog raises WatchdogError into the wreck path after
+        heal_watchdog_ms, chaos token poisoning corrupts the fetched
+        copies, and the NaN/garbage sentinel screens every token id
+        before any reaches a client queue. Touches no engine
+        bookkeeping — runs under _book on the sync path and lock-free
+        on the fetcher thread."""
+        def fetch():
+            if self._chaos is not None:
+                self._chaos.maybe_hang()
+            return jax.device_get(  # graftlint: allow(hot-sync, lock-block) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
+                ([(f, d) for _, _, f, d in admits], chunk_handles)
+            )
+
+        if self._heal is not None and self._heal.watchdog_ms > 0:
+            admit_data, chunk_data = self._heal.bounded_fetch(fetch)
+        else:
+            admit_data, chunk_data = fetch()
+        if self._chaos is not None and self._chaos.cfg.nan_inject:
+            # device_get host copies may be read-only views; poisoning
+            # needs owned arrays (chaos-only path, never hot).
+            admit_data = [
+                (np.array(f), np.array(d)) for f, d in admit_data
+            ]
+            if chunk_data is not None:
+                chunk_data = tuple(np.array(a) for a in chunk_data)
+            self._chaos.poison_fetch(
+                [f for f, _ in admit_data]
+                + ([chunk_data[0]] if chunk_data is not None else [])
+            )
+        if self._heal is not None:
+            self._heal.check_tokens(
+                admit_data, chunk_data, self.cfg.vocab_size
+            )
+        return admit_data, chunk_data
+
     def _process_boundary(self, admits, chunk_handles, roster,  # graftlint: holds(_book)
-                          timing=None) -> None:
+                          timing=None, epoch=None) -> None:
         """Fetch one boundary's device results (one parallel transfer) and
         run host bookkeeping. `timing` is the wave's (dispatch t0,
         variant keys, roof rider) triple when DISPATCH_TIMING is on,
-        None otherwise."""
+        None otherwise. A wave from a pre-rebuild epoch is discarded
+        wholesale (see _PendingWave.epoch)."""
+        if epoch is not None and epoch != self._wave_epoch:
+            return
         if self._chaos is not None:
             self._chaos.maybe_slow_boundary()  # graftlint: allow(lock-block) deliberate chaos fault: a slow boundary under _book is exactly the race window being tested
         roofing = self._roof is not None and timing is not None
         f0 = time.perf_counter() if roofing else 0.0
-        admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync, lock-block) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
-            (
-                [(f, d) for _, _, f, d in admits],
-                chunk_handles,
-            )
+        admit_data, chunk_data = self._fetch_boundary(
+            admits, chunk_handles
         )
         f1 = time.perf_counter() if roofing else 0.0
         self._process_admits(admits, admit_data)
@@ -4340,6 +4810,8 @@ class InferenceEngine:
             self._san.audit(self)
         if self._sled is not None:
             self._sled.audit()
+        if self._heal is not None:
+            self._heal.note_boundary_ok()
 
     def _make_timing(self):  # graftlint: holds(_book)
         """Boundary timing token built at dispatch end: (stamp, wave
@@ -4498,19 +4970,21 @@ class InferenceEngine:
                         self._release_blocks(req)
 
     def _drain_and_fail(self, err: str, current=None) -> None:
-        """Async-mode failure: drain every queued boundary (their rosters
-        may hold requests already recycled out of _slots) and fail the
-        lot — called under NO lock; takes _book itself."""
-        pendings = [current] if current is not None else []
-        while True:
-            try:
-                item = self._fetch_q.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None:
-                pendings.append(item)
+        """Async-mode failure: fail — or, with the heal supervisor
+        armed, resurrect — every request a wrecked boundary may have
+        owned. In-flight waves are gathered from the registry (see
+        _inflight_waves), NOT by draining _fetch_q: a queue drain here
+        raced the scheduler's lock-free puts, so waves dispatched
+        between the drain and the epoch bump were never gathered and
+        their requests stranded when the fetcher later discarded them
+        as stale. Stale waves stay queued; the fetcher retires them.
+        `current` is a partial wreck (e.g. _dispatch_wreck) that never
+        reached the registry. Called under NO lock; takes _book
+        itself."""
         with self._book:
-            self._fail_all(err, pendings)
+            self._fail_or_heal(
+                err, [current] if current is not None else []
+            )
 
     def _fetch_loop(self) -> None:
         """Boundary-fetcher thread: device_get (a full host<->device
@@ -4522,19 +4996,30 @@ class InferenceEngine:
             item = self._fetch_q.get()
             if item is None:
                 return
-            admits, chunk_handles, roster, timing = item
+            admits, chunk_handles, roster, timing, epoch = item
             try:
+                with self._book:
+                    if epoch != self._wave_epoch:
+                        # Dispatched against pre-rebuild device state
+                        # while a fault was being healed: the roster
+                        # references dead slots and its requests were
+                        # already gathered from the registry and
+                        # resurrected — fetching or screening it could
+                        # only double tokens or re-trip recovery.
+                        continue
                 if self._san is not None:
                     self._san.perturb("boundary")
                 if self._chaos is not None:
                     self._chaos.maybe_slow_boundary()
                 roofing = self._roof is not None and timing is not None
                 f0 = time.perf_counter() if roofing else 0.0
-                admit_data, chunk_data = jax.device_get(  # graftlint: allow(hot-sync) deliberate boundary fetch; handles were host-copied via copy_to_host_async at dispatch
-                    ([(f, d) for _, _, f, d in admits], chunk_handles)
+                admit_data, chunk_data = self._fetch_boundary(
+                    admits, chunk_handles
                 )
                 f1 = time.perf_counter() if roofing else 0.0
                 with self._book:
+                    if epoch != self._wave_epoch:
+                        continue  # rebuild raced the fetch: stale wave
                     self._process_admits(admits, admit_data)
                     if chunk_data is not None:
                         self._process_chunk(*chunk_data, roster)
@@ -4545,9 +5030,16 @@ class InferenceEngine:
                         self._san.audit(self)
                     if self._sled is not None:
                         self._sled.audit()
+                    if self._heal is not None:
+                        self._heal.note_boundary_ok()
             except Exception as e:
                 logger.exception("boundary fetch failed")
                 self._drain_and_fail(str(e), current=item)
+            finally:
+                # Retire exactly once on every path — processed, stale-
+                # dropped, or faulted (after recovery gathered it).
+                with self._book:
+                    self._wave_retire(item)
 
     def _loop(self) -> None:
         # Software-pipelined scheduler: chunk N+1 is dispatched BEFORE
@@ -4620,7 +5112,7 @@ class InferenceEngine:
         table to cover the chunk's worst-case positions (evicting /
         preempting on exhaustion), then pass the fresh tables alongside
         the donated state."""
-        self._chaos_dispatch("decode")
+        self._chaos_dispatch("decode", self._live_wave_rids())
         if self._paged:
             self._grow_decode_blocks(n)
             if not self._observe:
@@ -4663,6 +5155,8 @@ class InferenceEngine:
                 self.cancel(victim)
         if self._draining.is_set():
             self._shed_queued_locked()
+        if self._heal is not None:
+            self._heal_tick()
         now = time.perf_counter()
         self._drain_pending()
         if self._waiting and any(
@@ -4746,10 +5240,10 @@ class InferenceEngine:
             self._dispatch_prefill_chunks() if self._chunked
             else self._dispatch_admits()
         )
-        self._dispatch_wreck = (admits, None, None, None)
+        self._dispatch_wreck = _PendingWave(admits, None, None, None)
         if admits or self._active_host.any():
             roster = self._roster()
-            self._dispatch_wreck = (admits, None, roster, None)
+            self._dispatch_wreck = _PendingWave(admits, None, roster, None)
             n = self._pick_chunk()
             self._state, toks, valid, active_after = (
                 self._dispatch_decode_chunk(n)
@@ -4789,7 +5283,10 @@ class InferenceEngine:
                 self._recorder.record("boundary", -1, detail)
             timing = self._make_timing() if self._timing_on else None
             self._dispatch_wreck = None
-            return (admits, (toks, valid, active_after), roster, timing)
+            return _PendingWave(
+                admits, (toks, valid, active_after), roster, timing,
+                self._wave_epoch,
+            )
         self._dispatch_wreck = None
         return None
 
@@ -4799,6 +5296,13 @@ class InferenceEngine:
             try:
                 with self._book:
                     work = self._dispatch_once()
+                    # Register the wave before releasing _book: requests
+                    # recycled out of _slots this dispatch live only in
+                    # its roster, and a recovery at ANY point before the
+                    # fetcher retires it gathers it from this registry
+                    # (see _gather_wrecked).
+                    if work is not None:
+                        self._inflight_waves.append(work)
             except Exception as e:
                 logger.exception("engine dispatch failed")
                 # _dispatch_once may have recycled requests out of
@@ -4812,7 +5316,9 @@ class InferenceEngine:
                     self._profile_tick()
                 # Bounded queue (maxsize=4): caps how far the host's
                 # slot-state view may lag behind retired boundaries.
-                # Blocks OUTSIDE the lock, so the fetcher keeps draining.
+                # Blocks OUTSIDE the lock, so the fetcher keeps
+                # draining; the wave stays registered until the fetcher
+                # retires it.
                 self._fetch_q.put(work)
             elif self._pending.empty():
                 if self._sled is not None:
@@ -4831,7 +5337,7 @@ class InferenceEngine:
         if self._spec:
             self._loop_sync_spec()
             return
-        pending: Optional[Tuple[list, Any, list, Any]] = None
+        pending: Optional[_PendingWave] = None
         while not self._stop.is_set():
             admits, roster = [], None  # visible to the except path
             try:
@@ -4891,7 +5397,8 @@ class InferenceEngine:
                     if pending is not None:
                         self._process_boundary(*pending)
                     pending = (
-                        (admits, chunk_handles, roster, timing)
+                        _PendingWave(admits, chunk_handles, roster, timing,
+                                     self._wave_epoch)
                         if (admits or chunk_handles is not None)
                         else None
                     )
@@ -4913,8 +5420,9 @@ class InferenceEngine:
                 # The CURRENT iteration's admits/roster may hold requests
                 # already recycled out of _slots — fail them too.
                 with self._book:
-                    self._fail_all(
-                        str(e), [pending, (admits, None, roster, None)]
+                    self._fail_or_heal(
+                        str(e),
+                        [pending, _PendingWave(admits, None, roster, None)],
                     )
                 pending = None
         # Drain the in-flight boundary so stop() doesn't strand requests.
@@ -4935,7 +5443,7 @@ class InferenceEngine:
         fetched. Requests optimistically recycled out of _slots live in
         `pending` rosters and the dispatch wreck, so the error path
         fails both."""
-        pending: Optional[Tuple[list, Any, list, Any]] = None
+        pending: Optional[_PendingWave] = None
         while not self._stop.is_set():
             try:
                 with self._book:
@@ -4962,7 +5470,7 @@ class InferenceEngine:
                     wreck, self._dispatch_wreck = (
                         self._dispatch_wreck, None
                     )
-                    self._fail_all(str(e), [pending, wreck])
+                    self._fail_or_heal(str(e), [pending, wreck])
                 pending = None
         # Drain the in-flight boundary so stop() doesn't strand requests.
         if pending is not None:

@@ -199,7 +199,10 @@ class JAXServer(SeldonComponent):
         # (0 = none; per-request deadline_ms still applies). Chaos fault
         # injection is env-only (CHAOS=1 + CHAOS_* knobs, read by the
         # engine itself via ChaosConfig.from_env) — never a unit param,
-        # so a deployment manifest can't enable it by accident.
+        # so a deployment manifest can't enable it by accident. The
+        # graftheal supervisor (servers/supervisor.py) follows the same
+        # pattern: HEAL=1 + HEAL_MAX_RETRIES / HEAL_WATCHDOG_MS env,
+        # read by the engine via supervisor.build.
         self.max_queue = int(
             max_queue or _os.environ.get("MAX_QUEUE", "0") or 0
         )
@@ -491,7 +494,17 @@ class JAXServer(SeldonComponent):
             raise RuntimeError("engine draining")
         if self._slice_ready is not None:
             self._slice_ready.check()  # local accelerator sanity
-        return {"engine": self.engine.stats.snapshot()}
+        out = {"engine": self.engine.stats.snapshot()}
+        heal = self.engine.debug_health()
+        if heal is not None:
+            # Recovering/degraded is still READY — the engine is serving
+            # (that is the point of graftheal); operators read the state
+            # here and at /debug/health rather than losing the replica.
+            out["heal"] = {
+                "state": heal["state"],
+                "pressure": heal["pressure"],
+            }
+        return out
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Stop admitting, shed the queue (retriable errors), wait for
@@ -693,6 +706,13 @@ class JAXServer(SeldonComponent):
             return None
         return self.engine.debug_roof()
 
+    def debug_health(self) -> Optional[Dict]:
+        """Heal-supervisor snapshot for the /debug/health endpoint
+        (None when HEAL is off or nothing loaded)."""
+        if not self._loaded or self.engine is None:
+            return None
+        return self.engine.debug_health()
+
     def _observatory_metrics(self, s: Dict) -> List[Dict]:
         """Compile/HBM/sched-ledger and per-variant dispatch gauges.
         Empty when the observatory is off — the Prometheus surface only
@@ -813,6 +833,20 @@ class JAXServer(SeldonComponent):
                 {"type": "GAUGE",
                  "key": "jaxserver_roof_conservation_breaches",
                  "value": float(roof["conservation"]["breaches"])},
+            ])
+        heal = self.engine.debug_health()
+        if heal is not None:
+            out.extend([
+                {"type": "GAUGE", "key": "jaxserver_heal_resurrected",
+                 "value": float(heal["resurrected"])},
+                {"type": "GAUGE", "key": "jaxserver_heal_quarantined",
+                 "value": float(heal["quarantined"])},
+                {"type": "GAUGE", "key": "jaxserver_heal_watchdog_trips",
+                 "value": float(heal["watchdog_trips"])},
+                {"type": "GAUGE", "key": "jaxserver_heal_retry_exhausted",
+                 "value": float(heal["retry_exhausted"])},
+                {"type": "GAUGE", "key": "jaxserver_heal_pressure",
+                 "value": float(heal["pressure"])},
             ])
         return out
 

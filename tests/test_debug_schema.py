@@ -1,11 +1,12 @@
 """Frozen-schema golden tests for the debug observatory snapshots.
 
-``/debug/compile``, ``/debug/hbm``, ``/debug/sched``, ``/debug/pilot``
-and ``/debug/roof`` are consumed by parties that never import this
-repo's dataclasses: the loadtester's ledger polls,
+``/debug/compile``, ``/debug/hbm``, ``/debug/sched``, ``/debug/pilot``,
+``/debug/roof`` and ``/debug/health`` are consumed by parties that
+never import this repo's dataclasses: the loadtester's ledger polls,
 ``tools/compile_audit.py`` / ``tools/sched_audit.py`` /
-``tools/pilot_audit.py`` / ``tools/roof_audit.py``,
-``tools/probe_hbm``, and whatever dashboards operators curl together.
+``tools/pilot_audit.py`` / ``tools/roof_audit.py`` /
+``tools/heal_audit.py``, ``tools/probe_hbm``, and whatever dashboards
+operators curl together.
 Their schemas are frozen here as literal key sets.  If one of these
 tests fails, you changed the wire contract: update the module
 docstrings in ``seldon_tpu/servers/compile_ledger.py`` /
@@ -23,6 +24,7 @@ from seldon_tpu.servers.controller import PilotController
 from seldon_tpu.servers.cost_model import RoofLedger
 from seldon_tpu.servers.hbm_ledger import HbmLedger
 from seldon_tpu.servers.sched_ledger import SchedLedger
+from seldon_tpu.servers.supervisor import HealSupervisor
 
 # The documented /debug/compile schema, frozen.
 COMPILE_TOP_KEYS = frozenset({
@@ -132,6 +134,29 @@ PILOT_SIGNAL_KEYS = frozenset({
     "budget_offered_tokens", "budget_used_tokens", "pool_stall_events",
     "preemptions", "deadline_expired", "spec_drafted", "spec_accepted",
     "goodput", "queue_depth", "free_slots", "roof_backlog_ms",
+    "heal_pressure",
+})
+
+# The documented /debug/health schema, frozen (graftheal's
+# HealSupervisor.snapshot(); tools/heal_audit.py polls it).
+HEALTH_TOP_KEYS = frozenset({
+    "enabled",
+    "state",
+    "mode",
+    "max_retries",
+    "watchdog_ms",
+    "resurrected",
+    "quarantined",
+    "watchdog_trips",
+    "retry_exhausted",
+    "sentinel_trips",
+    "recoveries",
+    "consecutive_faults",
+    "clean_boundaries",
+    "pen",
+    "suspects",
+    "probing",
+    "pressure",
 })
 
 # The documented /debug/roof schema, frozen (tools/roof_audit.py
@@ -247,6 +272,7 @@ def _populated_pilot() -> PilotController:
         "preemptions": 0, "deadline_expired": 0, "spec_drafted": 0,
         "spec_accepted": 0, "goodput": 1.0,
         "queue_depth": 0, "free_slots": 4, "roof_backlog_ms": 0.0,
+        "heal_pressure": 0.0,
     }
     _windows(base)  # window 1 only baselines
     starved = dict(base, budget_dispatches=4, budget_starved_passes=4,
@@ -533,6 +559,69 @@ def test_roof_snapshot_empty_ledger_same_keys():
     assert snap["totals"]["mfu"] == 0.0
 
 
+def _populated_supervisor() -> HealSupervisor:
+    """A supervisor exercising every snapshot branch: one recovery
+    (state leaves healthy), a resurrection counted, a penned repeat
+    replay, and a bisection round in flight (suspects + probing
+    non-empty)."""
+    import types as _t
+
+    sup = HealSupervisor(max_retries=4, watchdog_ms=50)
+    now = time.perf_counter()
+    # First fault over rids 1..3: everyone resurrects.
+    v1 = sup.plan_recovery([1, 2, 3], now)
+    assert set(v1.values()) == {"resurrect"}
+    for _ in v1:
+        sup.note_resurrected()
+    # Second fault over the same cohort: bisection starts; the
+    # non-probing half lands in the pen.
+    v2 = sup.plan_recovery([1, 2, 3], now)
+    assert "pen" in v2.values()
+    for rid, verdict in sorted(v2.items()):
+        if verdict == "pen":
+            sup.pen_put(_t.SimpleNamespace(rid=rid, finished=False), now)
+    return sup
+
+
+def test_health_snapshot_key_set_is_frozen():
+    snap = _populated_supervisor().snapshot()
+    assert set(snap) == HEALTH_TOP_KEYS
+
+
+def test_health_snapshot_value_kinds():
+    snap = _populated_supervisor().snapshot()
+    assert snap["enabled"] is True
+    assert snap["state"] in ("healthy", "recovering", "degraded")
+    assert snap["mode"] in ("normal", "bisect")
+    assert isinstance(snap["max_retries"], int)
+    assert isinstance(snap["watchdog_ms"], int)
+    for k in ("resurrected", "quarantined", "watchdog_trips",
+              "retry_exhausted", "sentinel_trips", "recoveries",
+              "consecutive_faults", "clean_boundaries", "pen"):
+        assert isinstance(snap[k], int) and snap[k] >= 0
+    assert isinstance(snap["suspects"], list)
+    assert isinstance(snap["probing"], list)
+    # The fixture left a bisection in flight with a populated pen.
+    assert snap["mode"] == "bisect"
+    assert snap["suspects"] and snap["probing"]
+    assert snap["pen"] >= 1
+    assert snap["resurrected"] == 3 and snap["recoveries"] == 2
+    # Pressure restates the state machine: recovering (no quarantine or
+    # exhaustion happened) reads 0.5.
+    assert snap["state"] == "recovering" and snap["pressure"] == 0.5
+
+
+def test_health_snapshot_fresh_supervisor_same_keys():
+    # A never-faulted supervisor serves the SAME key set (consumers
+    # need no existence checks), just with empty/zero values.
+    snap = HealSupervisor().snapshot()
+    assert set(snap) == HEALTH_TOP_KEYS
+    assert snap["state"] == "healthy" and snap["pressure"] == 0.0
+    assert snap["mode"] == "normal"
+    assert snap["suspects"] == [] and snap["probing"] == []
+    assert snap["pen"] == 0 and snap["recoveries"] == 0
+
+
 def test_snapshots_are_json_clean():
     # All snapshots must survive json.dumps untouched — they go over
     # the wire verbatim from the debug routes.
@@ -546,3 +635,5 @@ def test_snapshots_are_json_clean():
     assert set(pilot) == PILOT_TOP_KEYS
     roof = json.loads(json.dumps(_populated_roof_ledger().snapshot()))
     assert set(roof) == ROOF_TOP_KEYS
+    heal = json.loads(json.dumps(_populated_supervisor().snapshot()))
+    assert set(heal) == HEALTH_TOP_KEYS

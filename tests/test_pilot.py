@@ -86,7 +86,7 @@ class _Signals:
     def __init__(self, **levels):
         self.cum = {k: 0 for k in controller._DELTA_KEYS}
         self.levels = {"goodput": 1.0, "queue_depth": 0, "free_slots": 4,
-                       "roof_backlog_ms": 0.0}
+                       "roof_backlog_ms": 0.0, "heal_pressure": 0.0}
         self.levels.update(levels)
 
     def advance(self, **vals):

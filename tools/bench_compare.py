@@ -19,12 +19,17 @@ and compared base -> candidate with a direction heuristic:
    substring would claim them as higher-is-better), graftroof's
    ``host_frac`` (scheduler overhead share of the boundary wall), and
    graftmesh's ``kv_per_device_frac`` (TP-leg per-chip KV bytes over
-   the single-chip leg's — ~1/tp when the pool shards);
+   the single-chip leg's — ~1/tp when the pool shards), and graftheal's
+   ``user_visible_errors`` (streams a seeded fault storm still failed
+   in front of the user — quarantine + retry exhaustion are the only
+   sanctioned sources, so any rise is a recovery regression);
  * higher-is-better: names containing ``req_per_s``, ``req_s``,
    ``tokens_per_s``, ``tok_s``, ``speedup``, ``hit_rate``, ``goodput``,
    ``coverage``, ``acceptance_rate`` (graftspec: a better drafter keeps
    more of every verify wave), plus the headline ``value`` /
-   ``vs_baseline`` and graftroof's achieved ``mfu`` / ``mbu``; the
+   ``vs_baseline``, graftroof's achieved ``mfu`` / ``mbu`` and
+   graftheal's ``goodput_retained_frac`` (bit-identical completions
+   over offered under the BENCH_HEAL fault storm); the
    exact leaf ``dispatch_per_token`` gates lower-is-better (verify
    waves compress the decode loop), and ``roof_predicted_req_s`` stays
    informational (it moves when the COST MODEL changes, not when the
@@ -63,7 +68,11 @@ _HIGHER = ("req_per_s", "req_s", "tokens_per_s", "tok_s", "speedup",
 # Exact leaf-name matches for the headline numbers. graftroof's
 # utilization gauges gate higher-is-better: a PR that drops achieved
 # MFU/MBU at the same throughput spent more hardware for the same work.
-_HIGHER_EXACT = ("value", "vs_baseline", "mfu", "mbu")
+# "goodput_retained_frac" is graftheal's: the share of a seeded fault
+# storm's offered requests that still completed bit-identical to the
+# clean leg — resurrection working less well shows up here first.
+_HIGHER_EXACT = ("value", "vs_baseline", "mfu", "mbu",
+                 "goodput_retained_frac")
 # Exact lower-is-better leaves, checked BEFORE the substring tables:
 # "goodput_gap" would otherwise match the higher-is-better "goodput"
 # substring, and "padding_waste_frac" matches nothing ("frac" != "frag").
@@ -73,9 +82,12 @@ _HIGHER_EXACT = ("value", "vs_baseline", "mfu", "mbu")
 # "kv_per_device_frac" is graftmesh's sharding dividend — the TP leg's
 # per-chip KV bytes as a fraction of the single-chip leg's; exact-TP
 # splits the head axis, so it should sit at ~1/tp and only rise if a
-# regression stops the pool from sharding.
+# regression stops the pool from sharding. "user_visible_errors" is
+# graftheal's headline — streams a seeded fault storm still failed in
+# front of the user; quarantine and retry exhaustion are its only
+# sanctioned sources, so any rise is a recovery regression.
 _LOWER_EXACT = ("padding_waste_frac", "goodput_gap", "dispatch_per_token",
-                "host_frac", "kv_per_device_frac")
+                "host_frac", "kv_per_device_frac", "user_visible_errors")
 # Model-side constants, never gated: "roof_predicted_req_s" moves when
 # the COST MODEL changes, not when the served binary regresses.
 _INFO_EXACT = ("roof_predicted_req_s",)

@@ -108,6 +108,30 @@ KNOBS = {
     "DEFAULT_DEADLINE_MS": _k("engine-serving", "0 (none)",
                               "Default per-request TTL in ms; per-request "
                               "deadline_ms still wins."),
+    "HEAL": _k("engine-serving", "0",
+               "graftheal supervised fault recovery: a faulted wave "
+               "rebuilds device state and RESURRECTS every innocent "
+               "in-flight request by replaying its committed tokens "
+               "(deterministic per-position sampling keys make the "
+               "continued stream bit-identical, greedy or sampled); "
+               "repeat faulters are bisected down to a poison "
+               "quarantine. Off (the default) leaves the raw "
+               "fail-everything path byte-identical to the pre-heal "
+               "engine. State machine at /debug/health; gated by "
+               "`make heal-audit`."),
+    "HEAL_MAX_RETRIES": _k("engine-serving", "4",
+                           "Per-request replay budget: how many times one "
+                           "request may ride a faulted wave before its "
+                           "next fault fails it terminally "
+                           "(kind=internal, retriable=false) instead of "
+                           "re-entering the backoff pen. Must be >= 1."),
+    "HEAL_WATCHDOG_MS": _k("engine-serving", "0 (off)",
+                           "Bound every boundary fetch to this wall-clock "
+                           "budget: a fetch that exceeds it is declared a "
+                           "hung wave and recovered like a dispatch "
+                           "fault (the wedged worker thread is "
+                           "abandoned, never joined). 0 fetches inline "
+                           "with no watchdog thread."),
 
     # --- chaos fault injection (servers/chaos.py, env-only by design) -----
     "CHAOS": _k("chaos", "0", "Master switch (`1`/`true`/`yes`); never a "
@@ -123,6 +147,20 @@ KNOBS = {
     "CHAOS_SLOW_MS": _k("chaos", "5", "Delay for a slow boundary, ms."),
     "CHAOS_DISCONNECT": _k("chaos", "0", "Probability a client disconnect "
                            "is injected (stream close -> cancel)."),
+    "CHAOS_NAN_INJECT": _k("chaos", "0", "Probability a fetched boundary's "
+                           "token ids are overwritten out-of-vocab (what "
+                           "NaN logits / corrupt DMA look like to the "
+                           "host; drives the graftheal sentinel)."),
+    "CHAOS_HANG": _k("chaos", "0", "Probability a boundary fetch sleeps "
+                     "CHAOS_HANG_MS (drives the graftheal watchdog's "
+                     "hung-wave declaration)."),
+    "CHAOS_HANG_MS": _k("chaos", "200", "Duration of an injected fetch "
+                        "hang, ms; set past HEAL_WATCHDOG_MS to trip the "
+                        "watchdog."),
+    "CHAOS_STICKY_RID": _k("chaos", "-1 (off)", "Request id that faults "
+                           "EVERY whole-batch wave it rides — the "
+                           "deterministic poison-quarantine bisection "
+                           "test vector."),
 
     # --- runtime concurrency sanitizer (servers/graftsan.py) --------------
     "GRAFTSAN": _k("sanitizer", "0",
@@ -414,6 +452,17 @@ KNOBS = {
                      "and sharding-dividend record is."),
     "BENCH_MESH_TP": _k("bench-harness", "2",
                         "TP group size for the mesh phase leg."),
+    "BENCH_HEAL": _k("bench-harness", "0",
+                     "Run the graftheal phase: the same greedy closed "
+                     "wave clean vs under seeded CHAOS dispatch faults "
+                     "with HEAL on, asserting resurrected streams "
+                     "bit-identical to the clean leg and reporting "
+                     "goodput_retained_frac (bench_compare gates it "
+                     "higher-is-better) and user_visible_errors "
+                     "(lower-is-better, exact)."),
+    "BENCH_HEAL_FAULT": _k("bench-harness", "0.05",
+                           "Dispatch-fault probability for the heal "
+                           "phase's chaos leg."),
     "BENCH_SLO": _k("bench-harness", "1 for bench-1b, else 0",
                     "Run the TTFT SLO search phase."),
     "BENCH_SLO_CHUNK": _k("bench-harness", "0 (adaptive)",

@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""CI heal audit: graftheal survives a seeded fault storm end to end.
+
+Boots the tiny warmed JAXServer behind the real REST app with
+``HEAL=1`` + ``CHAOS=1`` (+ ``GRAFTSAN=1``, ``FLIGHT_RECORDER=1``,
+``COMPILE_LEDGER=1``) and a storm of dispatch faults, slow boundaries,
+fetch hangs (past the watchdog) and NaN injections — every fault class
+the supervisor recovers from without a user-visible error; disconnect
+and the sticky poison rid stay off, so ZERO failed streams is the
+contract, not a tolerance. One pass asserts:
+
+ * idle engine -> the frozen /debug/health schema, state "healthy",
+   every recovery counter at zero, pressure 0.0;
+ * a fixed greedy + sampled submit wave through the CHAOS engine is
+   BYTE-IDENTICAL to the same wave on a clean reference engine sharing
+   the server's params (replay-based resurrection with per-position
+   sampling keys makes that the contract, not a hope), with zero error
+   items — and the storm really fired (the wave is topped up until at
+   least one dispatch fault lands);
+ * the supervisor recovered at least once and resurrected at least one
+   request; quarantine and retry exhaustion stayed at zero (no poison
+   source is armed);
+ * /healthz stays ready THROUGH the storm (a recovering engine keeps
+   serving — only not-loaded/draining read 503) and the loadtester
+   completes requests against the faulting server;
+ * the books stay clean: zero graftsan lock-contract violations and
+   zero live retraces (resurrection re-enters existing prefill buckets,
+   so recovery compiles nothing);
+ * recoveries land as flight-recorder "heal" records carrying state +
+   verdict counts, the jaxserver Prometheus surface exports the
+   ``jaxserver_heal_*`` gauges, and ``tools/trace_view.py`` renders the
+   heal lane + verdict counters.
+
+Run via ``make heal-audit`` (wired into ``make ci``); exits non-zero
+with a one-line diagnosis on the first failed check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import io
+import json
+import os
+import sys
+
+# Frozen /debug/health top-level key set — tests/test_debug_schema.py
+# carries the same golden; a mismatch here means the snapshot schema
+# changed without updating its consumers.
+HEALTH_TOP_KEYS = frozenset({
+    "enabled", "state", "mode", "max_retries", "watchdog_ms",
+    "resurrected", "quarantined", "watchdog_trips", "retry_exhausted",
+    "sentinel_trips", "recoveries", "consecutive_faults",
+    "clean_boundaries", "pen", "suspects", "probing", "pressure",
+})
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        print(f"heal-audit FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _drain(q) -> tuple:
+    """(tokens, error item or None) for one submit stream."""
+    toks, err = [], None
+    while True:
+        item = q.get(timeout=300)
+        if item is None:
+            break
+        if "error" in item:
+            err = item
+            continue
+        toks.extend(item.get("tokens", []))
+    return toks, err
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["HEAL"] = "1"
+    os.environ["HEAL_MAX_RETRIES"] = "6"
+    # Generous on shared CI iron: a legitimately slow CPU boundary must
+    # not trip it, the injected 1.5 s hang always does.
+    os.environ["HEAL_WATCHDOG_MS"] = "1000"
+    os.environ["GRAFTSAN"] = "1"
+    os.environ["FLIGHT_RECORDER"] = "1"
+    os.environ["COMPILE_LEDGER"] = "1"
+    # The storm: every recoverable fault class, no poison source
+    # (disconnect cancels a victim and sticky_rid convicts one — both
+    # would break the zero-visible-errors contract by design).
+    os.environ["CHAOS"] = "1"
+    os.environ["CHAOS_SEED"] = "17"
+    os.environ["CHAOS_DISPATCH_FAIL"] = "0.05"
+    os.environ["CHAOS_SLOW_BOUNDARY"] = "0.05"
+    os.environ["CHAOS_SLOW_MS"] = "2"
+    os.environ["CHAOS_HANG"] = "0.02"
+    os.environ["CHAOS_HANG_MS"] = "1500"
+    os.environ["CHAOS_NAN_INJECT"] = "0.02"
+
+    import asyncio
+    import threading
+    import urllib.request
+
+    from aiohttp import web
+
+    from seldon_tpu.loadtester import main as lt_main
+    from seldon_tpu.models.sampling import SamplingParams
+    from seldon_tpu.runtime.wrapper import build_rest_app
+    from seldon_tpu.servers.chaos import ChaosConfig
+    from seldon_tpu.servers.engine import InferenceEngine
+    from seldon_tpu.servers.jaxserver import JAXServer
+    from tools import trace_view
+
+    srv = JAXServer(preset="tiny", max_slots=4, max_seq_len=128, warmup=1)
+    srv.load()
+    _check(srv.engine._chaos is not None,
+           "CHAOS=1 armed but the engine has no chaos monkey")
+    _check(srv.engine._heal is not None,
+           "HEAL=1 armed but the engine has no heal supervisor")
+
+    holder, started = {}, threading.Event()
+
+    async def amain() -> None:
+        runner = web.AppRunner(build_rest_app(srv))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        while not holder.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    t = threading.Thread(target=lambda: asyncio.run(amain()), daemon=True)
+    t.start()
+    _check(started.wait(60), "REST app failed to start within 60s")
+    url = f"http://127.0.0.1:{holder['port']}"
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(url + path, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    # --- idle engine: frozen schema + neutral state ---------------------
+    idle = get("/debug/health")
+    _check(set(idle) == HEALTH_TOP_KEYS,
+           f"/debug/health keys drifted: got {sorted(idle)}")
+    _check(idle["enabled"] is True, "idle heal reports enabled=false")
+    _check(idle["state"] == "healthy", f"idle state = {idle['state']}")
+    for key in ("resurrected", "quarantined", "watchdog_trips",
+                "retry_exhausted", "sentinel_trips", "recoveries", "pen"):
+        _check(idle[key] == 0, f"idle engine counts {key}={idle[key]}")
+    _check(idle["pressure"] == 0.0,
+           f"idle pressure = {idle['pressure']}")
+
+    # --- byte-identity under the storm ----------------------------------
+    # The same greedy + seeded-sampled wave on the CHAOS server engine
+    # and on a clean reference engine sharing its params. Per-position
+    # sampling keys make the healed streams bit-identical, greedy and
+    # sampled alike. 300 s stream timeouts keep a wedged recovery from
+    # hanging CI silently.
+    eng = srv.engine
+    vocab = eng.cfg.vocab_size
+    prompts = [[3 + (7 * i + j) % (vocab - 4) for j in range(16)]
+               for i in range(24)]
+
+    def params_for(i: int) -> SamplingParams:
+        if i % 2 == 0:
+            return SamplingParams(temperature=0.0, top_k=0, top_p=1.0,
+                                  max_new_tokens=12, seed=i)
+        return SamplingParams(temperature=0.8, top_k=40, top_p=0.95,
+                              max_new_tokens=12, seed=i)
+
+    def run_wave(engine) -> tuple:
+        qs = [engine.submit(p, params_for(i))
+              for i, p in enumerate(prompts)]
+        drained = [_drain(q) for q in qs]
+        return ([toks for toks, _ in drained],
+                [err for _, err in drained])
+
+    storm_streams, storm_errs = run_wave(eng)
+    # Top up until the storm demonstrably fired: fault draws ride the
+    # boundary count, which shifts a little with scheduling, so a fixed
+    # wave can't PROVE a fault landed. Bounded at 20 extra waves.
+    extra_waves = 0
+    while (eng.chaos_counts().get("dispatch_faults", 0) == 0
+           and eng.chaos_counts().get("hangs", 0) == 0
+           and extra_waves < 20):
+        extra_waves += 1
+        more_streams, more_errs = run_wave(eng)
+        storm_streams.extend(more_streams)
+        storm_errs.extend(more_errs)
+    chaos = eng.chaos_counts()
+    _check(sum(chaos.values()) > 0,
+           f"chaos storm never fired after {extra_waves} extra waves")
+
+    ref = InferenceEngine(
+        eng.params, eng.cfg,
+        # Same engine config, chaos explicitly disarmed (an all-zero
+        # ChaosConfig wins over the CHAOS=1 env the server read).
+        dataclasses.replace(eng.ecfg, chaos=ChaosConfig()),
+    )
+    _check(ref._chaos is None, "reference engine armed the chaos monkey")
+    ref.warmup()
+    ref.start()
+    ref_streams, ref_errs = run_wave(ref)
+    for _ in range(extra_waves):
+        more_streams, more_errs = run_wave(ref)
+        ref_streams.extend(more_streams)
+        ref_errs.extend(more_errs)
+    ref.stop()
+    ref_bad = [e for e in ref_errs if e]
+    _check(not ref_bad, f"clean reference leg errored: {ref_bad[:1]}")
+
+    storm_bad = [e for e in storm_errs if e]
+    visible = len(storm_bad)
+    _check(visible == 0,
+           f"{visible} user-visible errors under a storm with no poison "
+           f"source: {storm_bad[:1]}")
+    for i, (got, want) in enumerate(zip(storm_streams, ref_streams)):
+        _check(
+            got == want,
+            f"stream {i} diverged after recovery "
+            f"({'greedy' if i % 2 == 0 else 'sampled'}): "
+            f"healed {got[:8]}... != clean {want[:8]}...",
+        )
+
+    health = get("/debug/health")
+    _check(health["recoveries"] >= 1,
+           f"storm fired ({chaos}) but the supervisor never recovered")
+    _check(health["resurrected"] >= 1,
+           f"recoveries={health['recoveries']} but nothing resurrected")
+    _check(health["quarantined"] == 0,
+           f"{health['quarantined']} quarantined with no poison source")
+    _check(health["retry_exhausted"] == 0,
+           f"{health['retry_exhausted']} exhausted retry budgets "
+           f"(heal_max_retries=6)")
+
+    # --- the server stays ready through live HTTP traffic ---------------
+    ready = get("/healthz")
+    _check(ready.get("status") == "ready",
+           f"/healthz = {ready} mid-storm (recovering must stay ready)")
+    try:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            lt_main([
+                url, "--transport", "generate", "--clients", "8",
+                "--seconds", "3",
+                "--prompt", "p" * 64,
+                "--max-new-tokens", "8",
+            ])
+        ledger = json.loads(buf.getvalue().strip().splitlines()[-1])
+        detail = ledger["detail"]
+        _check(detail["requests"] >= 1,
+               "loadtester completed no requests against the storm")
+        snap = get("/debug/timeline")
+        health = get("/debug/health")
+    finally:
+        holder["stop"] = True
+        t.join(timeout=10)
+
+    # --- books stay clean under recovery --------------------------------
+    san = srv.engine._san
+    _check(san is not None, "GRAFTSAN=1 but the engine has no sanitizer")
+    _check(not san.violations,
+           f"graftsan violations under recovery: {san.violations}")
+    comp = srv.engine.debug_compile()
+    _check(comp is not None, "COMPILE_LEDGER=1 but no compile ledger")
+    _check(comp["live_retrace_count"] == 0,
+           f"{comp['live_retrace_count']} live retraces — resurrection "
+           f"must re-enter existing prefill buckets, not compile")
+
+    # --- Prometheus surface ---------------------------------------------
+    gauges = {m["key"]: m["value"] for m in srv.metrics()}
+    for key in ("jaxserver_heal_resurrected", "jaxserver_heal_quarantined",
+                "jaxserver_heal_watchdog_trips",
+                "jaxserver_heal_retry_exhausted", "jaxserver_heal_pressure"):
+        _check(key in gauges, f"metrics() missing gauge {key}")
+    _check(gauges["jaxserver_heal_resurrected"] >= 1,
+           "jaxserver_heal_resurrected gauge stayed zero")
+
+    # --- flight recorder + trace_view heal lane -------------------------
+    heal_recs = [r for r in snap.get("records", [])
+                 if r["kind"] == "heal"]
+    _check(heal_recs, "no heal records in the timeline")
+    for r in heal_recs:
+        d = r.get("detail") or {}
+        _check("state" in d and "error" in d,
+               f"heal record missing state/error: {sorted(d)}")
+    out = json.loads(json.dumps(trace_view.convert(snap)))
+    lanes = {e["args"]["name"] for e in out["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    _check("seldon-tpu heal" in lanes,
+           f"trace_view rendered no heal process (got {lanes})")
+    counters = {e["name"] for e in out["traceEvents"] if e["ph"] == "C"}
+    _check("heal_verdicts" in counters,
+           f"trace_view rendered no heal verdict counters "
+           f"(got {counters})")
+
+    srv.engine.stop()
+
+    print(json.dumps({
+        "metric": "heal_audit",
+        "value": 1,
+        "detail": {
+            "streams": len(storm_streams),
+            "extra_waves": extra_waves,
+            "user_visible_errors": visible,
+            "loadtester_requests": detail["requests"],
+            "chaos": chaos,
+            "recoveries": health["recoveries"],
+            "resurrected": health["resurrected"],
+            "watchdog_trips": health["watchdog_trips"],
+            "sentinel_trips": health["sentinel_trips"],
+            "state": health["state"],
+            "heal_records": len(heal_recs),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,9 @@ _PILOT_PID = 3
 # graftroof's host/device step decomposition: host lane (tid 0) +
 # device lane (tid 1) per boundary.
 _ROOF_PID = 4
+# graftheal recoveries: one instant marker per wave-fault recovery plus
+# a verdict-count counter track (resurrect/pen/poison/exhausted).
+_HEAL_PID = 5
 
 
 def _wall_us(snapshot: Dict[str, Any], ts: float) -> float:
@@ -115,6 +118,22 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
             events.append({
                 "ph": "M", "pid": _PILOT_PID, "tid": 0,
                 "name": "thread_name", "args": {"name": "decisions"},
+            })
+        return 0
+
+    heal_named = False
+
+    def heal_track() -> int:
+        nonlocal heal_named
+        if not heal_named:
+            heal_named = True
+            events.append({
+                "ph": "M", "pid": _HEAL_PID, "name": "process_name",
+                "args": {"name": "seldon-tpu heal"},
+            })
+            events.append({
+                "ph": "M", "pid": _HEAL_PID, "tid": 0,
+                "name": "thread_name", "args": {"name": "recoveries"},
             })
         return 0
 
@@ -248,6 +267,18 @@ def convert(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                         "ph": "C", "pid": _PILOT_PID, "name": name,
                         "ts": ts, "args": {"value": detail[key]},
                     })
+        elif kind == "heal":
+            events.append({
+                "ph": "i", "pid": _HEAL_PID, "tid": heal_track(),
+                "name": f"recovery ({detail.get('state', '?')})",
+                "ts": ts, "s": "p", "args": detail,
+            })
+            events.append({
+                "ph": "C", "pid": _HEAL_PID, "name": "heal_verdicts",
+                "ts": ts,
+                "args": {k: detail.get(k, 0) for k in
+                         ("resurrect", "pen", "poison", "exhausted")},
+            })
         elif kind == "roof":
             # Recorded when boundary processing finishes (ts = done
             # stamp); the step's phases lay out backwards from there:
