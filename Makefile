@@ -15,8 +15,15 @@ native:
 # checked-in baseline, plus the graftflow dataflow trio (docs/operations.md
 # "Static dataflow: graftflow"): shape-lattice certification, the
 # (paged, chunked, prefix) config-reachability matrix with its dense-slab
-# kill-list, and the sharding-consistency rules — then a bytecode-compile
-# sweep of the serving + tools trees.
+# kill-list, and the sharding-consistency rules — plus the graftnum
+# numerics/lifetime certifier (docs/operations.md "Numerics invariants:
+# graftnum"): num-barrier (quantize scales + int8 dequant products must be
+# optimization_barrier-pinned before materialization boundaries),
+# use-after-donate (reads of donated jit buffers + host-side captures),
+# and einsum-broadcast/mask-dtype (silent size-1 label broadcast, bf16
+# mask fill). Prints per-pass graftnum counts next to the kill-list
+# needle and fails if the lint run itself exceeds its 60 s self-runtime
+# budget — then a bytecode-compile sweep of the serving + tools trees.
 lint:
 	python -m tools.graftlint
 	python -m compileall -q seldon_tpu tools

@@ -199,6 +199,10 @@ def _run_blocks_verify_sparse(params, x, cfg, positions, inv_freq, pool,
             preferred_element_type=jnp.float32,
         ) / (Dh**0.5)
         if quantized:
+            # graftlint: allow(num-barrier) factored-scale scores stay
+            # f32 end to end (preferred_element_type above, softmax
+            # below) — there is no low-precision rounding boundary for
+            # fusion placement to move.
             s_suf = s_suf \
                 * view["k_scale"].transpose(0, 2, 1)[:, :, None, None, :]
         s_suf = jnp.where(sm5, s_suf, rpa.NEG_INF)

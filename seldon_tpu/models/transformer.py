@@ -47,7 +47,9 @@ def _quantize_act(x: jnp.ndarray):
     scale breaks the engine's bit-exact-across-configs contract on
     near-ties (observed: tp=2 vs tp=1 greedy divergence at the 128
     bucket). The barrier costs one activation materialization the
-    int8 dot was about to force anyway."""
+    int8 dot was about to force anyway. Machine-certified: graftlint's
+    num-barrier pass proves every int8 scale in the tree reads a
+    barrier-pinned input (make lint)."""
     x = jax.lax.optimization_barrier(x)
     s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
     s = jnp.maximum(s, 1e-8)
@@ -87,6 +89,11 @@ def _qdot(x: jnp.ndarray, container: Dict[str, Any], name: str,
     y = jax.lax.dot_general(
         xq, w, (((xq.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
+    # graftlint: allow(num-barrier) the s32->f32 epilogue is exact
+    # algebra (per-channel scales commute with the dot); both inputs to
+    # the product are already-materialized jit values, so fusion cannot
+    # change the bits — the hazard lives in the SCALES, which are
+    # barrier-pinned inside _quantize_act.
     return (y.astype(jnp.float32) * xs
             * wscale.astype(jnp.float32)).astype(x.dtype)
 
@@ -98,6 +105,9 @@ def _embed_rows(params: Params, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
     scale = params.get("embed_scale")
     if scale is None:
         return rows
+    # graftlint: allow(num-barrier) weight dequant of constant embed
+    # rows: the int8 bits and per-column scale are load-time constants
+    # identical in every compilation, so the product is too.
     return rows.astype(dtype) * scale.astype(dtype)[0]
 Cache = Dict[str, jnp.ndarray]
 
@@ -551,8 +561,14 @@ def _run_blocks_prefill_prefix(params, x, cfg, positions, inv_freq, mask,
         pk = pl["k"].astype(q.dtype)
         pv = pl["v"].astype(q.dtype)
         if quantized:
-            pk = pk * pl["k_scale"][..., None].astype(q.dtype)
-            pv = pv * pl["v_scale"][..., None].astype(q.dtype)
+            # Barrier-pinned like ops/ragged_paged_attention._sparse_block:
+            # the dequanted prefix must materialize to ONE value before
+            # the concat so every consumer fusion reads the same bits
+            # (certified by graftlint's num-barrier pass).
+            pk = jax.lax.optimization_barrier(
+                pk * pl["k_scale"][..., None].astype(q.dtype))
+            pv = jax.lax.optimization_barrier(
+                pv * pl["v_scale"][..., None].astype(q.dtype))
         # Prefix is head-major [B, Hkv, Pb, Dh]; attention wants
         # token-major columns in front of the fresh suffix.
         k_all = jnp.concatenate([pk.transpose(0, 2, 1, 3), k], axis=1)

@@ -403,26 +403,54 @@ _FAMILY_RANK = {
     "draft": 9, "verify": 10,
 }
 
+# The dispatch-family set in warmup order — THE exported constant for
+# anything that enumerates families (tests, docs, audits). Derived from
+# FAMILIES so a new family cannot be registered without appearing here.
+FAMILY_TAGS: Tuple[str, ...] = tuple(
+    sorted(FAMILIES, key=_FAMILY_RANK.__getitem__))
+
 
 def warmup_order(keys: Set[Key]) -> List[Key]:
     return sorted(keys, key=lambda k: (_FAMILY_RANK[k[0]], k[1:]))
+
+
+# --- certifier grid: single source of truth ---------------------------------
+# PR 13 and PR 15 each shipped a one-line stale-pin fix because the
+# grid size was hand-pinned in two different test files. The component
+# constants below ARE the grid; tests derive counts from GRID_COUNT and
+# membership from FAMILY_TAGS instead of re-pinning literals.
+
+# (buckets, smax, slots, max_admit, C, budget)
+GRID_SHAPES: Tuple[Tuple, ...] = (
+    ((32, 128), 256, 8, 8, 64, 64),
+    ((32, 128), 128, 8, 8, 64, 64),    # top bucket fills the window
+    ((16, 64), 64, 4, 4, 32, 96),      # budget packs 3 chunks
+    ((64,), 128, 2, 2, 64, 64),        # single bucket
+)
+# (paged, chunked, prefix) — the full flag cube.
+GRID_FLAG_COMBOS: Tuple[Tuple[bool, bool, bool], ...] = tuple(
+    itertools.product((False, True), repeat=3))
+# Ragged leg: paged+chunked forced, prefix trie on/off.
+GRID_RAGGED_COMBOS: Tuple[bool, ...] = (False, True)
+# Spec leg: (chunked, draft-resident), over the first two shapes only.
+GRID_SPEC_COMBOS: Tuple[Tuple[bool, bool], ...] = tuple(
+    itertools.product((False, True), repeat=2))
+GRID_SPEC_SHAPES = 2
+
+GRID_COUNT = (len(GRID_FLAG_COMBOS) * len(GRID_SHAPES)
+              + len(GRID_RAGGED_COMBOS) * len(GRID_SHAPES)
+              + len(GRID_SPEC_COMBOS) * GRID_SPEC_SHAPES)
 
 
 def grid() -> List[LatticeSpec]:
     """Representative spec grid for the certifier: all 8 flag combos
     over several bucket shapes, including the top-bucket == cache-window
     case (the historical warmup-width blind spot) and a multi-chunk
-    dispatch budget."""
-    shapes = [
-        # buckets, smax, slots, max_admit, C, budget
-        ((32, 128), 256, 8, 8, 64, 64),
-        ((32, 128), 128, 8, 8, 64, 64),    # top bucket fills the window
-        ((16, 64), 64, 4, 4, 32, 96),      # budget packs 3 chunks
-        ((64,), 128, 2, 2, 64, 64),        # single bucket
-    ]
+    dispatch budget. Built from the GRID_* constants above — len(grid())
+    == GRID_COUNT by construction."""
+    shapes = GRID_SHAPES
     specs = []
-    for paged, chunked, prefix in itertools.product((False, True),
-                                                    repeat=3):
+    for paged, chunked, prefix in GRID_FLAG_COMBOS:
         for buckets, smax, slots, ma, c, budget in shapes:
             specs.append(LatticeSpec(
                 buckets=buckets, max_seq_len=smax, max_slots=slots,
@@ -435,7 +463,7 @@ def grid() -> List[LatticeSpec]:
             ))
     # graftragged collapse: same shapes, paged+chunked forced (the
     # ragged wave's preconditions), with and without the prefix trie.
-    for prefix in (False, True):
+    for prefix in GRID_RAGGED_COMBOS:
         for buckets, smax, slots, ma, c, budget in shapes:
             specs.append(LatticeSpec(
                 buckets=buckets, max_seq_len=smax, max_slots=slots,
@@ -449,8 +477,8 @@ def grid() -> List[LatticeSpec]:
     # graftspec: the verify/draft ladders replace the decode rungs —
     # paged forced (spec's precondition), crossed with chunked prefill
     # and draft-model residency.
-    for chunked, sdraft in itertools.product((False, True), repeat=2):
-        for buckets, smax, slots, ma, c, budget in shapes[:2]:
+    for chunked, sdraft in GRID_SPEC_COMBOS:
+        for buckets, smax, slots, ma, c, budget in shapes[:GRID_SPEC_SHAPES]:
             specs.append(LatticeSpec(
                 buckets=buckets, max_seq_len=smax, max_slots=slots,
                 max_admit=ma, decode_rungs=(4, 8), paged=True,

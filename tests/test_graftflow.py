@@ -70,10 +70,10 @@ def rules(findings):
 
 def test_grid_closed_form_matches_simulation():
     specs = shape_lattice.grid()
-    # 8 flag combos x 4 bucket shapes + 2 ragged combos x 4 shapes
-    # + 4 spec combos x 2 shapes (graftspec grew the grid but not
-    # this pin)
-    assert len(specs) == 48
+    # Derived, not pinned: PR 13 and PR 15 each shipped a stale-pin fix
+    # here; GRID_COUNT is now the single source of truth next to the
+    # grid components it is computed from.
+    assert len(specs) == shape_lattice.GRID_COUNT
     for spec in specs:
         holes, waste = shape_lattice.check_spec(spec)
         assert holes == [], (spec, holes)

@@ -25,6 +25,11 @@ actually dispatched must be a member of ``engine.static_lattice()``
 (the ``shape_lattice.dispatch_keys`` enumeration), and the declared
 variant count must equal the static lattice size — i.e. warmup
 declared exactly the statically-certified set, nothing ad hoc.
+Before booting anything it also runs the graftnum certifier passes
+(num-barrier / use-after-donate / einsum-broadcast + mask-dtype) over
+``seldon_tpu/`` and fails if any finding survives the inline waivers:
+a tree the audit is about to *measure* must already be numerics- and
+lifetime-clean, or the measured bits aren't the contract bits.
 
 The audit then runs a second, RAGGED leg — once per attention-kernel
 leg (``RAGGED_KERNEL=masked`` and ``sparse``; graftkern): the same
@@ -86,6 +91,26 @@ def main(argv=None) -> int:
              "engine.static_lattice() and that warmup declared exactly "
              "the static lattice (graftflow's closed-form model)")
     args = ap.parse_args(argv)
+
+    if args.static_xcheck:
+        # graftnum gate first: static, cheap, and a prerequisite — if
+        # the tree has an uncertified fusion boundary or a use-after-
+        # donate path, the runtime numbers below measure the bug.
+        from pathlib import Path
+
+        from tools.graftlint import core, donate, einsumcheck, numbarrier
+
+        root = Path(__file__).resolve().parent.parent
+        files = core.load_tree([root / "seldon_tpu"], root)
+        ctx = core.Context(root)
+        findings = core.run_passes(
+            files, ctx, [numbarrier.run, donate.run, einsumcheck.run])
+        for f in findings:
+            print(f"compile-audit graftnum: {f.render()}", file=sys.stderr)
+        _check(not findings,
+               f"graftnum: {len(findings)} uncertified finding(s) in "
+               "seldon_tpu/ — fix or waive inline before auditing")
+        print(f"compile-audit: graftnum clean over {len(files)} file(s)")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ["COMPILE_LEDGER"] = "1"

@@ -7,16 +7,22 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 from pathlib import Path
 from typing import List
 
-from . import (configmatrix, hotpath, knobs, lockorder, locks, outcome,
-               retrace, shapelattice, shardcheck)
+from . import (configmatrix, donate, einsumcheck, hotpath, knobs,
+               lockorder, locks, numbarrier, outcome, retrace,
+               shapelattice, shardcheck)
 from .core import (Context, Finding, PLACEHOLDER_NOTE, load_baseline,
                    load_tree, run_passes, write_baseline)
 
 PASSES = [hotpath.run, locks.run, lockorder.run, retrace.run, outcome.run,
-          knobs.run, shapelattice.run, configmatrix.run, shardcheck.run]
+          knobs.run, shapelattice.run, configmatrix.run, shardcheck.run,
+          numbarrier.run, donate.run, einsumcheck.run]
+
+# Self-runtime budget: pass growth must not make `make lint` unusable.
+DEFAULT_BUDGET_S = 60.0
 
 
 def _repo_root() -> Path:
@@ -37,7 +43,9 @@ def main(argv: List[str] | None = None) -> int:
         prog="python -m tools.graftlint",
         description="seldon-tpu invariant checker (hot-sync, lock-guard, "
                     "lockorder, retrace, outcome, env-knob, shape-lattice, "
-                    "config-matrix, shard-axis/-host-pull/-jit)")
+                    "config-matrix, shard-axis/-host-pull/-jit, "
+                    "num-barrier, use-after-donate, einsum-broadcast/"
+                    "mask-dtype)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files/dirs to lint (default: seldon_tpu tools "
                          "bench.py bench_orchestrator.py "
@@ -54,7 +62,13 @@ def main(argv: List[str] | None = None) -> int:
                     help="regenerate docs/knobs.md and exit")
     ap.add_argument("--gen-config-matrix", action="store_true",
                     help="regenerate docs/config_matrix.md and exit")
+    ap.add_argument("--budget-s", type=float, default=DEFAULT_BUDGET_S,
+                    metavar="SECONDS",
+                    help="fail (exit 1) if the lint run itself exceeds "
+                         "this wall-clock budget; 0 disables "
+                         f"(default {DEFAULT_BUDGET_S:.0f})")
     args = ap.parse_args(argv)
+    t_start = time.monotonic()
 
     if args.write_baseline and not (args.note and args.note.strip()):
         ap.error("--write-baseline requires --note \"<reason>\" — every "
@@ -100,6 +114,33 @@ def main(argv: List[str] | None = None) -> int:
               f"reachable only with paged_kv=False "
               f"(docs/config_matrix.md)")
 
+    # graftnum headline: per-pass site/finding counts next to the
+    # kill-list needle, so the certified-numerics surface is visible
+    # in the same CI line block.
+    num_rules = {"num-barrier": "numbarrier",
+                 "use-after-donate": "donate",
+                 "einsum-broadcast": "einsumcheck",
+                 "mask-dtype": "einsumcheck"}
+    per_pass = {"numbarrier": 0, "donate": 0, "einsumcheck": 0}
+    for f in findings:
+        p = num_rules.get(f.rule)
+        if p is not None:
+            per_pass[p] += 1
+    nb = ctx.stats.get("numbarrier", {})
+    dn = ctx.stats.get("donate", {})
+    es = ctx.stats.get("einsumcheck", {})
+    print(f"graftnum: numbarrier {per_pass['numbarrier']} finding(s) "
+          f"({nb.get('scale_sites', 0)} scale + "
+          f"{nb.get('dequant_sites', 0)} dequant site(s), "
+          f"{nb.get('certified', 0)} barrier-certified) | "
+          f"donate {per_pass['donate']} finding(s) "
+          f"({dn.get('donating_jits', 0)} donating jit(s), "
+          f"{dn.get('donating_calls', 0)} call site(s)) | "
+          f"einsumcheck {per_pass['einsumcheck']} finding(s) "
+          f"({es.get('shape_traced', 0)}/"
+          f"{es.get('contraction_sites', 0)} contraction(s) "
+          f"shape-traced)")
+
     baseline = {} if args.no_baseline else load_baseline(ctx.baseline_path)
     if args.write_baseline:
         write_baseline(ctx.baseline_path, findings, baseline,
@@ -132,13 +173,21 @@ def main(argv: List[str] | None = None) -> int:
 
     for f in fresh:
         print(f.render())
+
+    elapsed = time.monotonic() - t_start
+    over_budget = bool(args.budget_s) and elapsed > args.budget_s
+    if over_budget:
+        print(f"graftlint: self-runtime budget exceeded: {elapsed:.1f}s "
+              f"> {args.budget_s:.0f}s — trim or parallelize passes "
+              f"before adding more", file=sys.stderr)
+
     if fresh:
         print(f"\ngraftlint: {len(fresh)} finding(s) "
               f"({len(used)} suppressed by baseline)")
         return 1
     print(f"graftlint: OK — {len(findings)} finding(s), all accepted in "
           f"baseline" if findings else "graftlint: OK — no findings")
-    return 0
+    return 1 if over_budget else 0
 
 
 if __name__ == "__main__":

@@ -86,6 +86,9 @@ class Context:
         self.baseline_path = repo_root / BASELINE_NAME
         self.knobs_doc = repo_root / "docs" / "knobs.md"
         self.matrix_doc = repo_root / "docs" / "config_matrix.md"
+        # Passes drop per-pass counters here (sites scanned, sites
+        # certified, ...); the CLI prints them as the run headline.
+        self.stats: Dict[str, dict] = {}
 
 
 def _parse_controls(lines: Sequence[str]):
@@ -159,6 +162,30 @@ def allowed(sf: SourceFile, rule: str, *linenos: int) -> bool:
         rules = sf.allows.get(ln)
         if rules and (rule in rules or "*" in rules):
             return True
+    return False
+
+
+def allowed_above(sf: SourceFile, rule: str, line: int,
+                  *also: int) -> bool:
+    """allowed(), plus the comment block immediately preceding `line` —
+    multi-line waiver reasons don't fit a trailing comment, so
+
+        # graftlint: allow(<rule>) long reason
+        # continuing over several lines
+        flagged_statement()
+
+    waives the statement it directly precedes (blank/comment lines only
+    between the allow and the flagged line)."""
+    if allowed(sf, rule, line, *also):
+        return True
+    ln = line - 1
+    while ln >= 1:
+        text = sf.line_text(ln).strip()
+        if text and not text.startswith("#"):
+            return False
+        if allowed(sf, rule, ln):
+            return True
+        ln -= 1
     return False
 
 

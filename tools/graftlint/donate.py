@@ -1,0 +1,462 @@
+"""Use-after-donate certification for jit buffer donation (graftnum).
+
+``jax.jit(..., donate_argnums=...)`` hands the donated argument's
+device buffer to the output: after the call the old array object still
+*exists* on the host but its buffer is dead, and touching it raises (on
+TPU) or silently reads stale memory (some backends).  The engine leans
+on donation everywhere — every decode/chunk/verify step donates the KV
+state in, gets the updated state out — so the ONLY safe shapes are:
+
+ * same-statement rebind: ``self._state = self._jit_step(p, self._state)``
+ * tuple rebind:          ``self._state, tok = self._jit_step(p, self._state)``
+ * hand-off return:       ``return self._jit_step(p, self._state)`` with
+   the caller rebinding immediately (the callee never reads it again)
+
+This pass builds the donated-callable registry from every jit site in
+the file — ``x = jax.jit(f, donate_argnums=(1,))``, attribute bindings
+``self._jit_x = ...``, dict-of-jits comprehensions called through
+``self._jit_chunks[n](...)``, ``@functools.partial(jax.jit, ...,
+donate_argnums=...)`` decorators, and conditional aliases
+``fn = a if c else b`` — then walks each function flagging any read of
+a donated buffer's binding after the donating call on any path.
+``donate_argnums`` indices refer to the jitted callable's positional
+call-site arguments; keyword pre-binding via ``functools.partial`` and
+``static_argnums`` do NOT shift them.
+
+Host-side capture is the sneaky variant: ``book[k] = state`` stores a
+reference, and a later donation of ``state`` invalidates the book's
+entry too — exactly the hazard that forced ``microbench_decode.py``'s
+old fresh-pool-per-width workaround.  Donating a binding that a
+container captured earlier is therefore also a finding.
+
+Rule ``use-after-donate``.  Waive with
+``# graftlint: allow(use-after-donate) why`` when the read is provably
+metadata-only (``.shape``/``.dtype`` survive donation) or the capture
+is of a copy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import core
+
+RULE = "use-after-donate"
+
+# Binding key: ("n", name) for locals, ("a", attr) for self.<attr>.
+Key = Tuple[str, str]
+
+
+def _call_tail(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _donate_idxs(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums of a jax.jit(...) call, or None if not a
+    donating jit call."""
+    if _call_tail(call.func) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+            return ()  # dynamic spec: treat as donating, unknown idxs
+    return None
+
+
+def _find_jit_call(node: ast.AST) -> Optional[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            idxs = _donate_idxs(n)
+            if idxs is not None:
+                return n
+    return None
+
+
+def _binding_key(node: ast.expr) -> Optional[Key]:
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return ("a", node.attr)
+    return None
+
+
+class _Registry:
+    """Donated callables visible in a file: name / self-attr ->
+    donate idx tuple.  Dict-of-jits bindings are called through a
+    Subscript of the same name/attr, so the key covers both."""
+
+    def __init__(self) -> None:
+        self.keys: Dict[Key, Tuple[int, ...]] = {}
+
+    def callee_idxs(self, func: ast.expr) -> Optional[Tuple[int, ...]]:
+        # fn(...) / self._jit_x(...) / self._jit_chunks[n](...) / d[n](...)
+        base = func
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        key = _binding_key(base)
+        if key is None:
+            return None
+        return self.keys.get(key)
+
+
+def _collect_registry(tree: ast.AST) -> _Registry:
+    reg = _Registry()
+    for node in ast.walk(tree):
+        # x = jax.jit(..., donate_argnums=...) / self._jit_x = ...
+        # x = {n: jax.jit(...) for ...} / x = (a if c else b)
+        if isinstance(node, ast.Assign):
+            jc = _find_jit_call(node.value)
+            idxs: Optional[Tuple[int, ...]] = None
+            if jc is not None:
+                idxs = _donate_idxs(jc)
+            elif isinstance(node.value, ast.IfExp):
+                a = _binding_key(node.value.body)
+                b = _binding_key(node.value.orelse)
+                got: Set[int] = set()
+                for k in (a, b):
+                    if k is not None and k in reg.keys:
+                        got.update(reg.keys[k])
+                if got:
+                    idxs = tuple(sorted(got))
+            else:
+                src = _binding_key(node.value)
+                if src is not None and src in reg.keys:
+                    idxs = reg.keys[src]
+            if idxs is not None:
+                for t in node.targets:
+                    key = _binding_key(t)
+                    if key is not None:
+                        reg.keys[key] = idxs
+        # @functools.partial(jax.jit, ..., donate_argnums=...)
+        # @jax.jit -> no donation; plain decorated fn with donate
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    idxs = _donate_idxs(dec)
+                    if idxs is None and _call_tail(dec.func) == "partial":
+                        for arg in ast.walk(dec):
+                            if isinstance(arg, ast.Call):
+                                got2 = _donate_idxs(arg)
+                                if got2 is not None:
+                                    idxs = got2
+                                    break
+                        if idxs is None:
+                            for kw in dec.keywords:
+                                if kw.arg in ("donate_argnums",
+                                              "donate_argnames"):
+                                    fake = ast.Call(
+                                        func=ast.Name(id="jit",
+                                                      ctx=ast.Load()),
+                                        args=[], keywords=[kw])
+                                    idxs = _donate_idxs(fake)
+                    if idxs is not None:
+                        reg.keys[("n", node.name)] = idxs
+    return reg
+
+
+def _read_keys(node: ast.AST) -> List[Tuple[Key, int]]:
+    """(key, line) for every Load of a trackable binding in node.
+    Metadata-only reads (.shape/.dtype/.ndim) survive donation and are
+    skipped; so is the attribute base 'self' itself."""
+    out: List[Tuple[Key, int]] = []
+    meta = {"shape", "dtype", "ndim", "size"}
+    skip: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in meta:
+            for sub in ast.walk(n.value):
+                skip.add(id(sub))
+    for n in ast.walk(node):
+        if id(n) in skip:
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.append((("n", n.id), n.lineno))
+        elif (isinstance(n, ast.Attribute)
+              and isinstance(n.ctx, ast.Load)
+              and isinstance(n.value, ast.Name)
+              and n.value.id == "self"):
+            out.append((("a", n.attr), n.lineno))
+    return out
+
+
+def _target_keys(target: ast.expr) -> List[Key]:
+    out: List[Key] = []
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            out.extend(_target_keys(e))
+    elif isinstance(target, ast.Starred):
+        out.extend(_target_keys(target.value))
+    else:
+        k = _binding_key(target)
+        if k is not None:
+            out.append(k)
+    return out
+
+
+class _State:
+    def __init__(self) -> None:
+        self.donated: Dict[Key, int] = {}     # key -> donation line
+        self.captured: Dict[Key, int] = {}    # key -> capture line
+
+    def copy(self) -> "_State":
+        st = _State()
+        st.donated = dict(self.donated)
+        st.captured = dict(self.captured)
+        return st
+
+    def merge(self, other: "_State") -> None:
+        # Union: donated-on-ANY-path is the hazard.
+        for k, v in other.donated.items():
+            self.donated.setdefault(k, v)
+        for k, v in other.captured.items():
+            self.captured.setdefault(k, v)
+
+
+class _FnChecker:
+    def __init__(self, sf: core.SourceFile, reg: _Registry,
+                 fn: ast.AST, findings: List[core.Finding]) -> None:
+        self.sf = sf
+        self.reg = reg
+        self.fn = fn
+        self.findings = findings
+        self.stats_calls = 0
+
+    # -- helpers ---------------------------------------------------
+
+    def _flag(self, line: int, msg: str, hint: str,
+              anchor: ast.AST) -> None:
+        if core.allowed_above(self.sf, RULE, line, self.fn.lineno):
+            return
+        self.findings.append(core.make_finding(
+            self.sf, RULE, line, msg, hint=hint,
+            qualname=core.qualname_of(anchor)))
+
+    def _donations(self, stmt: ast.AST) -> List[Tuple[Key, ast.Call]]:
+        """Donated-binding keys handed to donating calls in stmt."""
+        out: List[Tuple[Key, ast.Call]] = []
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            idxs = self.reg.callee_idxs(n.func)
+            if idxs is None:
+                continue
+            self.stats_calls += 1
+            if not idxs:  # dynamic donate spec: every positional arg
+                idxs = tuple(range(len(n.args)))
+            for i in idxs:
+                if i < len(n.args):
+                    k = _binding_key(n.args[i])
+                    if k is not None:
+                        out.append((k, n))
+        return out
+
+    # -- statement walk --------------------------------------------
+
+    def run(self) -> None:
+        st = _State()
+        self._walk_body(getattr(self.fn, "body", []), st)
+
+    def _walk_body(self, body: Sequence[ast.stmt], st: _State) -> bool:
+        """Walk a statement list; True when the body provably leaves
+        this scope (return/raise/break/continue) — statements after
+        the terminator are unreachable and a terminated branch's state
+        must NOT merge back at an If join."""
+        for stmt in body:
+            if self._walk_stmt(stmt, st):
+                return True
+        return False
+
+    def _walk_stmt(self, stmt: ast.stmt, st: _State) -> bool:
+        if isinstance(stmt, ast.If):
+            self._check_expr(stmt.test, st)
+            a = st.copy()
+            b = st.copy()
+            ta = self._walk_body(stmt.body, a)
+            tb = self._walk_body(stmt.orelse, b)
+            if ta and tb:
+                return True
+            if ta:
+                st.donated, st.captured = b.donated, b.captured
+            elif tb:
+                st.donated, st.captured = a.donated, a.captured
+            else:
+                st.donated = {}
+                st.captured = {}
+                st.merge(a)
+                st.merge(b)
+            return False
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_expr(stmt.iter, st)
+            # Two sweeps: the second sees donations from the first
+            # iteration, catching donate-then-read-across-iterations.
+            for _ in range(2):
+                for k in _target_keys(stmt.target):
+                    st.donated.pop(k, None)
+                    st.captured.pop(k, None)
+                self._walk_body(stmt.body, st)
+            self._walk_body(stmt.orelse, st)
+            return False
+        if isinstance(stmt, ast.While):
+            for _ in range(2):
+                self._check_expr(stmt.test, st)
+                self._walk_body(stmt.body, st)
+            self._walk_body(stmt.orelse, st)
+            return False
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._check_expr(item.context_expr, st)
+                if item.optional_vars is not None:
+                    for k in _target_keys(item.optional_vars):
+                        st.donated.pop(k, None)
+            return self._walk_body(stmt.body, st)
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, st)
+            for h in stmt.handlers:
+                hs = st.copy()
+                self._walk_body(h.body, hs)
+                st.merge(hs)
+            self._walk_body(stmt.orelse, st)
+            return self._walk_body(stmt.finalbody, st)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return False  # nested defs get their own checker
+        # Flat statement: reads -> captures -> donations -> clears.
+        self._flat_stmt(stmt, st)
+        return isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                 ast.Continue))
+
+    def _check_expr(self, expr: Optional[ast.AST], st: _State) -> None:
+        if expr is None:
+            return
+        donations = self._donations(expr)
+        donated_args = {id(c.args[i]) for k, c in donations
+                        for i in range(len(c.args))
+                        if _binding_key(c.args[i]) == k}
+        for key, line in _read_keys(expr):
+            if key in st.donated:
+                self._note_read(key, line, st, expr, donated_args)
+        for key, call in donations:
+            self._apply_donation(key, call, st)
+
+    def _note_read(self, key: Key, line: int, st: _State,
+                   stmt: ast.AST, donated_args: Set[int]) -> None:
+        label = key[1] if key[0] == "n" else f"self.{key[1]}"
+        self._flag(
+            line,
+            f"reads {label} after its buffer was donated at line "
+            f"{st.donated[key]} — donate_argnums hands the device "
+            f"buffer to the jit output, so this read sees a dead "
+            f"(deleted or reused) buffer",
+            hint="rebind in the same statement: "
+                 "state = jit_step(params, state); or drop the "
+                 "donation if the old value is still needed",
+            anchor=stmt)
+        # Flag once per binding, not once per subsequent read.
+        st.donated.pop(key, None)
+
+    def _apply_donation(self, key: Key, call: ast.Call,
+                        st: _State) -> None:
+        if key in st.captured:
+            label = key[1] if key[0] == "n" else f"self.{key[1]}"
+            self._flag(
+                call.lineno,
+                f"donates {label} while a host-side container still "
+                f"holds a reference captured at line "
+                f"{st.captured[key]} — the captured entry's buffer "
+                f"dies with the donation",
+                hint="capture a copy (jnp.copy / jax.device_get) or "
+                     "move the capture after the last donation",
+                anchor=call)
+            st.captured.pop(key, None)
+        st.donated[key] = call.lineno
+
+    def _flat_stmt(self, stmt: ast.stmt, st: _State) -> None:
+        donations = self._donations(stmt)
+        donation_keys = {k for k, _ in donations}
+
+        # 1) reads of already-dead bindings (donated BEFORE this
+        #    statement).  The donated argument of this statement's own
+        #    call is the hand-off, not a use-after.
+        for key, line in _read_keys(stmt):
+            if key in st.donated:
+                self._note_read(key, line, st, stmt, set())
+
+        # 2) host-side capture: container[i] = x / book.append(x).
+        #    A key donated in this same statement is consumed by the
+        #    call, not captured (the stored value is the call result).
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    for key, line in _read_keys(stmt.value):
+                        if key not in donation_keys:
+                            st.captured.setdefault(key, line)
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if _call_tail(call.func) in ("append", "add", "update",
+                                         "setdefault", "insert"):
+                for arg in call.args:
+                    for key, line in _read_keys(arg):
+                        if key not in donation_keys:
+                            st.captured.setdefault(key, line)
+
+        # 3) donations fire
+        for key, call in donations:
+            self._apply_donation(key, call, st)
+
+        # 4) assignment targets are fresh bindings
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                continue  # container store, not a rebind
+            for k in _target_keys(t):
+                st.donated.pop(k, None)
+                st.captured.pop(k, None)
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                for k in _target_keys(t):
+                    st.donated.pop(k, None)
+                    st.captured.pop(k, None)
+
+
+def run(files: List[core.SourceFile], ctx: core.Context) -> List[core.Finding]:
+    findings: List[core.Finding] = []
+    jit_sites = 0
+    call_sites = 0
+    for sf in files:
+        core.attach_parents(sf.tree)
+        reg = _collect_registry(sf.tree)
+        jit_sites += len(reg.keys)
+        if not reg.keys:
+            continue
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            chk = _FnChecker(sf, reg, fn, findings)
+            chk.run()
+            call_sites += chk.stats_calls
+    stats = getattr(ctx, "stats", None)
+    if stats is not None:
+        stats["donate"] = {
+            "donating_jits": jit_sites,
+            "donating_calls": call_sites,
+        }
+    return findings

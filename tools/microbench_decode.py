@@ -181,19 +181,23 @@ def bench_spec(k: int, weights: str, kv: str, attn: str = "xla") -> None:
 
     from tools.timing import slope_time
 
-    def time_width(kk: int) -> float:
-        # Fresh pool per width: the donated state dies with its timing.
-        state = {
-            "cache": transformer.init_paged_cache(cfg, B * nbs + 1, block),
-            "last_tok": jnp.ones((B,), jnp.int32),
-            "pos": jnp.full((B,), 128, jnp.int32),
-            "active": jnp.ones((B,), jnp.bool_),
-            "remaining": jnp.full((B,), 64, jnp.int32),
-            "temp": jnp.zeros((B,), jnp.float32),
-            "top_k": jnp.zeros((B,), jnp.int32),
-            "top_p": jnp.ones((B,), jnp.float32),
-            "seeds": jnp.arange(B, dtype=jnp.uint32),
-        }
+    # One pool for the whole pair: each width's jit donates the state
+    # in and slope_time hands the final state to the next leg — the
+    # idiomatic donation chain (every chain link resets pos/active, so
+    # timings are width-comparable regardless of who ran before).
+    state = {
+        "cache": transformer.init_paged_cache(cfg, B * nbs + 1, block),
+        "last_tok": jnp.ones((B,), jnp.int32),
+        "pos": jnp.full((B,), 128, jnp.int32),
+        "active": jnp.ones((B,), jnp.bool_),
+        "remaining": jnp.full((B,), 64, jnp.int32),
+        "temp": jnp.zeros((B,), jnp.float32),
+        "top_k": jnp.zeros((B,), jnp.int32),
+        "top_p": jnp.ones((B,), jnp.float32),
+        "seeds": jnp.arange(B, dtype=jnp.uint32),
+    }
+
+    def time_width(kk: int, state: dict):
         drafts = jnp.ones((B, kk), jnp.int32)
         fn = jax.jit(functools.partial(spec_model.verify_wave, cfg=cfg),
                      donate_argnums=(1,))
@@ -205,11 +209,11 @@ def bench_spec(k: int, weights: str, kv: str, attn: str = "xla") -> None:
             st, _, _ = fn(params, st, table, drafts, wave)
             return st
 
-        dt, _ = slope_time(one, state, k1=2, k2=6)
-        return 1000.0 * dt
+        dt, state = slope_time(one, state, k1=2, k2=6)
+        return 1000.0 * dt, state
 
-    ms_plain = time_width(0)
-    ms_verify = time_width(k)
+    ms_plain, state = time_width(0, state)
+    ms_verify, state = time_width(k, state)
     draft_ms = 0.0
     draft_preset = os.environ.get("MB_DRAFT", "")
     if draft_preset:
@@ -290,20 +294,24 @@ def bench_ragged(weights: str, kv: str, attn: str = "xla") -> None:
 
     from tools.timing import slope_time
 
-    def time_kernel(kern: str) -> float:
-        # Fresh pool per leg: the donated state dies with its timing.
-        # State arrays are copies — the wave args stay undonated.
-        state = {
-            "cache": transformer.init_paged_cache(cfg, B * nbs + 1, block),
-            "last_tok": jnp.ones((B,), jnp.int32),
-            "pos": pos0 + 0,
-            "active": jnp.ones((B,), jnp.bool_),
-            "remaining": jnp.full((B,), 64, jnp.int32),
-            "temp": jnp.zeros((B,), jnp.float32),
-            "top_k": jnp.zeros((B,), jnp.int32),
-            "top_p": jnp.ones((B,), jnp.float32),
-            "seeds": jnp.arange(B, dtype=jnp.uint32),
-        }
+    # One pool chained through every leg: each kernel's jit donates the
+    # state in and slope_time's final state seeds the next leg. Every
+    # chain link resets pos/active/remaining, so leg timings stay
+    # comparable regardless of order.
+    # State arrays are copies — the wave args stay undonated.
+    state = {
+        "cache": transformer.init_paged_cache(cfg, B * nbs + 1, block),
+        "last_tok": jnp.ones((B,), jnp.int32),
+        "pos": pos0 + 0,
+        "active": jnp.ones((B,), jnp.bool_),
+        "remaining": jnp.full((B,), 64, jnp.int32),
+        "temp": jnp.zeros((B,), jnp.float32),
+        "top_k": jnp.zeros((B,), jnp.int32),
+        "top_p": jnp.ones((B,), jnp.float32),
+        "seeds": jnp.arange(B, dtype=jnp.uint32),
+    }
+
+    def time_kernel(kern: str, state: dict):
         fn = jax.jit(
             functools.partial(ra.ragged_wave, cfg=cfg, kernel=kern),
             donate_argnums=(1,))
@@ -316,13 +324,15 @@ def bench_ragged(weights: str, kv: str, attn: str = "xla") -> None:
                                 finals, is_prefill)
             return st
 
-        dt, _ = slope_time(one, state, k1=2, k2=6)
-        return 1000.0 * dt
+        dt, state = slope_time(one, state, k1=2, k2=6)
+        return 1000.0 * dt, state
 
     kernels = ["masked", "sparse"]
     if os.environ.get("MB_PALLAS", ""):
         kernels.append("pallas")
-    ms = {kern: time_kernel(kern) for kern in kernels}
+    ms = {}
+    for kern in kernels:
+        ms[kern], state = time_kernel(kern, state)
     line = (f"w={weights:5s} kv={kv:5s} act={cfg.act_dtype:5s} ragged "
             f"B={B} ctx~{int(pos0.mean())}/{Smax}")
     for kern in kernels:

@@ -19,6 +19,8 @@ GROUP = 128
 
 
 def quant8(w):
+    # graftlint: allow(num-barrier) probe: measures fusion alternatives
+    # on purpose; cross-compilation bit-stability is not a contract here.
     s = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
     return jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8), s
 
@@ -30,6 +32,8 @@ def quant4_packed(w):
     both offset-7 biased (value range [-7, 7] -> [0, 14])."""
     *lead, K, N = w.shape
     wg = w.reshape(*lead, K // GROUP, GROUP, N)
+    # graftlint: allow(num-barrier) probe: one compilation, host-checked
+    # against its own reference; no cross-leg bit contract.
     s = jnp.maximum(jnp.max(jnp.abs(wg), axis=-2, keepdims=True) / 7.0, 1e-12)
     q = jnp.clip(jnp.round(wg / s), -7, 7).astype(jnp.int8)
     q = q.reshape(*lead, K, N) + 7  # [0, 14]

@@ -21,6 +21,8 @@ def mk(key):
 
 
 def quant(w):
+    # graftlint: allow(num-barrier) probe: measures fusion alternatives
+    # on purpose; cross-compilation bit-stability is not a contract here.
     s = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
     return jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8), s
 
@@ -134,6 +136,8 @@ def attn_probe():
     run2("int8-factored", factored, ki, ks, vi, vs)
     # 4) int8 via direct int8 dot (int32 accum) then scale
     def int8dot(qx, ck, cs, cv, vs_):
+        # graftlint: allow(num-barrier) probe leg: fusion freedom is the
+        # measurement, not a hazard.
         qs = jnp.max(jnp.abs(qx.astype(jnp.float32)), axis=-1) / 127.0
         qi = jnp.clip(jnp.round(qx.astype(jnp.float32) / qs[..., None]),
                       -127, 127).astype(jnp.int8)
@@ -168,6 +172,8 @@ def attn_probe():
                     c, a = inner
                     idx = pos[:, None] + jnp.arange(1)[None, :]
                     if quant:
+                        # graftlint: allow(num-barrier) probe leg: fusion freedom is the
+                        # measurement, not a hazard.
                         sc = jnp.max(jnp.abs(kf.astype(jnp.float32)), -1) / 127.0
                         kq = jnp.clip(jnp.round(kf.astype(jnp.float32) / sc[..., None]), -127, 127).astype(jnp.int8)
                         c = dict(c)
@@ -247,6 +253,8 @@ def attn_probe():
                     idx = pos[:, None] + jnp.arange(1)[None, :]
                     c = dict(c)
                     if quant:
+                        # graftlint: allow(num-barrier) probe leg: fusion freedom is the
+                        # measurement, not a hazard.
                         sc = jnp.max(jnp.abs(kf.astype(jnp.float32)), -1) / 127.0
                         kq = jnp.clip(jnp.round(kf.astype(jnp.float32) / sc[..., None]), -127, 127).astype(jnp.int8)
                         c["k"] = c["k"].at[li, rows[:, None], idx].set(
